@@ -1,0 +1,15 @@
+"""tendermint_tpu — a TPU-native BFT consensus framework.
+
+A from-scratch framework with the capabilities of Tendermint Core (BFT
+consensus + ABCI app interface), re-designed TPU-first: the signature
+verification hot path (ed25519/sr25519 vote, commit, evidence and
+light-client checks) is accumulated into wide batches and executed by a
+JAX ZIP-215 batch-verify kernel on TPU, sharded over a device mesh for
+mega-commits.
+
+Layer map mirrors the reference's capability surface (see SURVEY.md §1):
+libs, crypto, types, p2p, abci/proxy, store/state, consensus, blockchain
+(fast sync), evidence, light, statesync, privval, rpc, node, cmd.
+"""
+
+__version__ = "0.1.0"

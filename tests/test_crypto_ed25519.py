@@ -1,0 +1,169 @@
+"""Ed25519 host-side tests: RFC 8032 vectors, ZIP-215 edge semantics."""
+
+import hashlib
+import os
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519, ed25519_ref
+
+# RFC 8032 §7.1 test vectors 1-3 (seed, pubkey, msg, sig).
+RFC8032_VECTORS = [
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+]
+
+
+@pytest.mark.parametrize("seed,pub,msg,sig", RFC8032_VECTORS)
+def test_rfc8032_sign_and_verify(seed, pub, msg, sig):
+    seed_b, pub_b = bytes.fromhex(seed), bytes.fromhex(pub)
+    msg_b, sig_b = bytes.fromhex(msg), bytes.fromhex(sig)
+    priv = ed25519.Ed25519PrivKey(seed_b)
+    assert priv.pub_key().bytes() == pub_b
+    assert priv.sign(msg_b) == sig_b
+    assert ed25519_ref.verify(pub_b, msg_b, sig_b)
+    assert priv.pub_key().verify_signature(msg_b, sig_b)
+    # Perturbations must fail.
+    assert not ed25519_ref.verify(pub_b, msg_b + b"x", sig_b)
+    bad = bytearray(sig_b)
+    bad[0] ^= 1
+    assert not ed25519_ref.verify(pub_b, msg_b, bytes(bad))
+
+
+def test_sign_matches_pure_python():
+    for i in range(8):
+        seed = hashlib.sha256(b"seed%d" % i).digest()
+        msg = b"message %d" % i
+        priv = ed25519.Ed25519PrivKey(seed)
+        assert priv.sign(msg) == ed25519_ref.sign(seed, msg)
+        assert priv.pub_key().bytes() == ed25519_ref.public_key_from_seed(seed)
+
+
+def test_noncanonical_s_rejected():
+    priv = ed25519.Ed25519PrivKey(hashlib.sha256(b"s").digest())
+    msg = b"hello"
+    sig = priv.sign(msg)
+    s = int.from_bytes(sig[32:], "little")
+    bad_s = s + ed25519_ref.L
+    if bad_s < 2**256:
+        bad = sig[:32] + bad_s.to_bytes(32, "little")
+        assert not priv.pub_key().verify_signature(msg, bad)
+
+
+def test_zip215_noncanonical_decompress():
+    """Encodings with y >= p decode as y mod p (RFC 8032 strict rejects them)."""
+    # p + 1 fits in 255 bits (p = 2^255 - 19), decodes to y = 1 -> identity.
+    enc = (ed25519_ref.P + 1).to_bytes(32, "little")
+    assert ed25519_ref.decompress(enc) == (0, 1)
+    # p + 3: y = 3; accept iff (y^2-1)/(dy^2+1) is square — just require the
+    # result to agree with the canonical encoding's result.
+    enc_nc = (ed25519_ref.P + 3).to_bytes(32, "little")
+    enc_c = (3).to_bytes(32, "little")
+    assert ed25519_ref.decompress(enc_nc) == ed25519_ref.decompress(enc_c)
+
+
+def test_zip215_noncanonical_r_accepted_in_verify():
+    """Full verify with a non-canonically encoded small-order R.
+
+    R encodes y = p + 1 (>= p, non-canonical) which ZIP-215 decodes to the
+    identity. With S = k*a mod L the cofactored equation holds. A strict
+    RFC 8032 verifier rejects this signature at decode time.
+    """
+    seed = hashlib.sha256(b"nc-r").digest()
+    priv = ed25519.Ed25519PrivKey(seed)
+    pub = priv.pub_key().bytes()
+    h = hashlib.sha512(seed).digest()
+    a = ed25519_ref._clamp(h)
+    r_enc = (ed25519_ref.P + 1).to_bytes(32, "little")
+    msg = b"zip215 non-canonical R"
+    k = (
+        int.from_bytes(hashlib.sha512(r_enc + pub + msg).digest(), "little")
+        % ed25519_ref.L
+    )
+    s = (k * a) % ed25519_ref.L
+    sig = r_enc + s.to_bytes(32, "little")
+    assert ed25519_ref.verify(pub, msg, sig)
+    # Sanity: strict OpenSSL verify rejects this ZIP-215-only signature.
+    try:
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PublicKey
+
+        strict = Ed25519PublicKey.from_public_bytes(pub)
+        try:
+            strict.verify(sig, msg)
+            strict_ok = True
+        except Exception:
+            strict_ok = False
+        assert not strict_ok
+    except ImportError:
+        pass
+
+
+def test_zip215_x0_sign1_accepted():
+    """Encoding with x == 0 and sign bit 1 decompresses (RFC 8032 rejects)."""
+    # y = 1 gives x = 0 (the identity point). Set the sign bit.
+    enc = (1 | (1 << 255)).to_bytes(32, "little")
+    pt = ed25519_ref.decompress(enc)
+    assert pt == (0, 1)
+
+
+def test_small_order_point_accepted_in_decompress():
+    # The order-2 point (0, -1).
+    enc = (ed25519_ref.P - 1).to_bytes(32, "little")
+    pt = ed25519_ref.decompress(enc)
+    assert pt == (0, ed25519_ref.P - 1)
+
+
+def test_cofactored_equation_small_order_r():
+    """A signature whose R is a small-order point: cofactored verify accepts
+    iff [8]([S]B - [k]A - R) == O; with R of order 8 the [8]R term vanishes."""
+    seed = hashlib.sha256(b"cof").digest()
+    priv = ed25519.Ed25519PrivKey(seed)
+    pub = priv.pub_key().bytes()
+    h = hashlib.sha512(seed).digest()
+    a = ed25519_ref._clamp(h)
+    # R := identity encoded (y=1, x=0): [8]R = O, so need [8]([S]B - [k]A) = O,
+    # i.e. S = k*a mod L works since then [S]B - [k]A = [k*a]B - [k][a]B = O.
+    r_enc = (1).to_bytes(32, "little")
+    msg = b"small order R"
+    k = (
+        int.from_bytes(hashlib.sha512(r_enc + pub + msg).digest(), "little")
+        % ed25519_ref.L
+    )
+    s = (k * a) % ed25519_ref.L
+    sig = r_enc + s.to_bytes(32, "little")
+    assert ed25519_ref.verify(pub, msg, sig)
+
+
+def test_address_and_registry():
+    from tendermint_tpu import crypto
+
+    priv = ed25519.Ed25519PrivKey.generate()
+    pub = priv.pub_key()
+    assert len(pub.address()) == 20
+    rt = crypto.pubkey_from_type_and_bytes("ed25519", pub.bytes())
+    assert rt == pub
+
+
+def test_keygen_from_secret_deterministic():
+    a = ed25519.Ed25519PrivKey.from_secret(b"abc")
+    b = ed25519.Ed25519PrivKey.from_secret(b"abc")
+    assert a.bytes() == b.bytes()

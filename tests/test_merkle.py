@@ -1,0 +1,44 @@
+"""Merkle tree + proof tests (reference capability: crypto/merkle)."""
+
+import hashlib
+
+from tendermint_tpu.crypto import merkle
+
+
+def test_empty_tree():
+    assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+
+
+def test_single_leaf():
+    item = b"tx0"
+    assert merkle.hash_from_byte_slices([item]) == hashlib.sha256(b"\x00" + item).digest()
+
+
+def test_two_leaves():
+    a, b = b"a", b"b"
+    la = hashlib.sha256(b"\x00" + a).digest()
+    lb = hashlib.sha256(b"\x00" + b).digest()
+    expect = hashlib.sha256(b"\x01" + la + lb).digest()
+    assert merkle.hash_from_byte_slices([a, b]) == expect
+
+
+def test_proofs_roundtrip_various_sizes():
+    for n in [1, 2, 3, 4, 5, 7, 8, 9, 33, 100]:
+        items = [b"item%d" % i for i in range(n)]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        assert root == merkle.hash_from_byte_slices(items)
+        assert len(proofs) == n
+        for i, proof in enumerate(proofs):
+            assert proof.total == n and proof.index == i
+            assert proof.verify(root, items[i])
+            # Wrong leaf/root must fail.
+            assert not proof.verify(root, items[i] + b"!")
+            assert not proof.verify(b"\x00" * 32, items[i])
+
+
+def test_proof_wrong_index_fails():
+    items = [b"x%d" % i for i in range(8)]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    p = proofs[3]
+    p.index = 4
+    assert not p.verify(root, items[3])
