@@ -1,0 +1,9 @@
+"""TPU-native crypto kernels (JAX).
+
+The device-side half of the `crypto.backend=tpu` capability: wide-batch
+ZIP-215 ed25519 verification. Layout convention throughout: field
+elements are int32 arrays of shape (22, N) — 22 limbs x 12 bits with the
+batch on the trailing axis so it lands on TPU vector lanes; the limb
+axis rides sublanes. All arithmetic is exact int32 with proven bounds
+(see field.py docstrings); no floating point touches consensus results.
+"""

@@ -1,0 +1,156 @@
+"""Batched edwards25519 point arithmetic on TPU limb vectors.
+
+Points are 4-tuples (X, Y, Z, T) of (22, N) limb arrays — extended
+homogeneous coordinates with x = X/Z, y = Y/Z, T = XY/Z. The addition
+formulas are the *complete* unified formulas for twisted Edwards curves
+with a = -1 (add-2008-hwcd-3 / dbl-2008-hwcd): valid for ALL inputs
+including identity, equal and small-order points — so window tables can
+contain the identity and no data-dependent branches exist anywhere,
+which is exactly what XLA wants.
+
+Decompression implements ZIP-215 semantics (see crypto/ed25519_ref.py):
+the 255-bit y is interpreted mod p (non-canonical encodings accepted)
+and x = 0 with sign bit 1 is accepted.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from . import field as fe
+
+
+class Point(NamedTuple):
+    x: jnp.ndarray
+    y: jnp.ndarray
+    z: jnp.ndarray
+    t: jnp.ndarray
+
+
+def identity(n: int) -> Point:
+    return Point(fe.splat(0, n), fe.splat(1, n), fe.splat(1, n), fe.splat(0, n))
+
+
+def neg(p: Point) -> Point:
+    return Point(fe.neg(p.x), p.y, p.z, fe.neg(p.t))
+
+
+def add(p: Point, q: Point) -> Point:
+    """Complete unified addition (add-2008-hwcd-3, a=-1)."""
+    a = fe.mul(fe.sub(p.y, p.x), fe.sub(q.y, q.x))
+    b = fe.mul(fe.add(p.y, p.x), fe.add(q.y, q.x))
+    c = fe.mul(fe.mul(p.t, q.t), _d2(p.x.shape[-1]))
+    d = fe.add(t := fe.mul(p.z, q.z), t)  # 2*Z1*Z2
+    e = fe.sub(b, a)
+    f = fe.sub(d, c)
+    g = fe.add(d, c)
+    h = fe.add(b, a)
+    return Point(fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+
+
+def add_z1(p: Point, qx, qy, qt) -> Point:
+    """Add a point with Z=1 (precomputed table entry): saves one mul."""
+    a = fe.mul(fe.sub(p.y, p.x), fe.sub(qy, qx))
+    b = fe.mul(fe.add(p.y, p.x), fe.add(qy, qx))
+    c = fe.mul(fe.mul(p.t, qt), _d2(p.x.shape[-1]))
+    d = fe.add(p.z, p.z)  # 2*Z1*1
+    e = fe.sub(b, a)
+    f = fe.sub(d, c)
+    g = fe.add(d, c)
+    h = fe.add(b, a)
+    return Point(fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+
+
+def double(p: Point) -> Point:
+    """dbl-2008-hwcd for a=-1 (sign-adjusted; matches ed25519_ref)."""
+    a = fe.sqr(p.x)
+    b = fe.sqr(p.y)
+    c = fe.add(t := fe.sqr(p.z), t)
+    h = fe.add(a, b)
+    e = fe.sub(h, fe.sqr(fe.add(p.x, p.y)))
+    g = fe.sub(a, b)
+    f = fe.add(c, g)
+    return Point(fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+
+
+def is_identity(p: Point) -> jnp.ndarray:
+    """(N,) bool: X == 0 and Y == Z (mod p). Excludes the order-2 point
+    (0, -1) since Y - Z = -2Z != 0 there; Z is never 0 for valid points
+    under complete formulas."""
+    return fe.is_zero(p.x) & fe.is_zero(fe.sub(p.y, p.z))
+
+
+_consts: dict = {}
+
+
+def _d2(n: int) -> jnp.ndarray:
+    key = ("d2", n)
+    if key not in _consts:
+        _consts[key] = fe.splat(fe.D2, n)
+    return _consts[key]
+
+
+def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray) -> tuple[Point, jnp.ndarray]:
+    """ZIP-215 decompression of a batch of encodings.
+
+    y_limbs: (22, N) — the low 255 bits of the encoding (any value
+    < 2^255; values >= p are implicitly reduced by field arithmetic).
+    sign: (N,) int32 in {0, 1} — the top bit.
+
+    Returns (Point with Z=1, ok mask). Lanes with ok=False carry the
+    identity so downstream point math stays well-defined.
+    """
+    n = y_limbs.shape[-1]
+    one = fe.splat(1, n)
+    yy = fe.sqr(y_limbs)
+    u = fe.sub(yy, one)
+    v = fe.add(fe.mul(yy, fe.splat(fe.D, n)), one)
+    # Candidate sqrt(u/v) = u v^3 (u v^7)^((p-5)/8)
+    v3 = fe.mul(fe.sqr(v), v)
+    v7 = fe.mul(fe.sqr(v3), v)
+    t = fe.pow_2_252_m3(fe.mul(u, v7))
+    x = fe.mul(fe.mul(u, v3), t)
+    vxx = fe.mul(v, fe.sqr(x))
+    ok1 = fe.eq(vxx, u)
+    ok2 = fe.eq(vxx, fe.neg(u))
+    x = jnp.where(ok2[None, :], fe.mul(x, fe.splat(fe.SQRT_M1, n)), x)
+    ok = ok1 | ok2
+    # Sign adjustment on the canonical representative. x=0 with sign=1
+    # stays 0 (ZIP-215 accepts; -0 == 0).
+    flip = (fe.parity(x) != sign)
+    x = jnp.where(flip[None, :], fe.neg(x), x)
+    # Zero out failed lanes to the identity to keep later math stable.
+    x = jnp.where(ok[None, :], x, fe.splat(0, n))
+    y = jnp.where(ok[None, :], y_limbs, one)
+    return Point(x, y, one, fe.mul(x, y)), ok
+
+
+def select(table: jnp.ndarray, digit: jnp.ndarray) -> Point:
+    """Per-lane table lookup. table: (W, 4, 22, N); digit: (N,) in [0, W).
+
+    Computed as a masked sum over the W entries — no gather, pure VPU.
+    """
+    w = table.shape[0]
+    oh = (digit[None, :] == jnp.arange(w, dtype=jnp.int32)[:, None])  # (W, N)
+    sel = jnp.sum(jnp.where(oh[:, None, None, :], table, 0), axis=0)
+    return Point(sel[0], sel[1], sel[2], sel[3])
+
+
+def select_const(table: jnp.ndarray, digit: jnp.ndarray) -> tuple:
+    """Shared-table lookup. table: (W, 3, 22) consts (x, y, t with Z=1);
+    digit: (N,). Contraction over W is a small matmul — MXU-friendly."""
+    w = table.shape[0]
+    oh = (digit[None, :] == jnp.arange(w, dtype=jnp.int32)[:, None]).astype(jnp.int32)
+    sel = jnp.einsum("wn,wcl->cln", oh, table)  # (3, 22, N)
+    return sel[0], sel[1], sel[2]
+
+
+def build_window_table(p: Point, width: int = 16) -> jnp.ndarray:
+    """[0..width-1] * P as a (width, 4, 22, N) array (entry 0 = identity)."""
+    n = p.x.shape[-1]
+    entries = [identity(n), p]
+    for _ in range(width - 2):
+        entries.append(add(entries[-1], p))
+    return jnp.stack([jnp.stack(list(e), axis=0) for e in entries], axis=0)

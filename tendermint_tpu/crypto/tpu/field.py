@@ -1,0 +1,250 @@
+"""GF(2^255-19) arithmetic on batched int32 limb vectors.
+
+Representation: a field element batch is an int32 array of shape
+(22, N): limb i holds 12 bits of weight 2^(12*i) (264 bits total), batch
+on the trailing axis. Values are *redundant* representatives: any
+integer in [0, 2^266) congruent to the element mod p.
+
+Bounds discipline (every op documents its contract; tests enforce it):
+
+- REDUCED: every limb < 7700. `mul`/`sqr` require REDUCED inputs —
+  then every schoolbook column is <= 22 * 7699^2 = 1.31e9 < 2^31, so
+  int32 never overflows — and produce REDUCED output.
+- `add`/`sub` accept REDUCED and produce REDUCED via one carry pass.
+- `canonical` produces the unique representative in [0, p) with 12-bit
+  limbs; used only for compares/parity (a few per verify, off the hot
+  path).
+
+The top-limb fold uses 2^264 = 2^9 * 19 (mod p): a carry c out of limb
+21 re-enters as 19*c at bit 9, split as ((19c)&7)<<9 into limb 0 plus
+(19c)>>3 into limb 1 so no intermediate exceeds int32. The &7 part is
+why REDUCED is 7700, not 4096: limb 0 can sit at 4095 + 3584 + eps
+after a single pass, and that is fine — the mul overflow bound has
+~1.6x headroom over it.
+
+Everything here is pure-functional jnp on int32 — no Python control
+flow on data — so the whole verifier jits into one XLA program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+P = 2**255 - 19
+NLIMB = 22
+BITS = 12
+MASK = (1 << BITS) - 1
+# 2^(12*22) = 2^264 ≡ 19 * 2^9 (mod p)
+FOLD = 19 << 9
+
+
+def to_limbs(x: int) -> np.ndarray:
+    """Python int -> (22,) int32 canonical limb vector. x must be < 2^264."""
+    assert 0 <= x < 1 << (BITS * NLIMB)
+    out = np.zeros(NLIMB, np.int32)
+    for i in range(NLIMB):
+        out[i] = x & MASK
+        x >>= BITS
+    return out
+
+
+def from_limbs(limbs):
+    """(K,) or (K, N) limb array -> Python int(s) — for tests/host."""
+    arr = np.asarray(limbs)
+    if arr.ndim == 1:
+        return sum(int(arr[i]) << (BITS * i) for i in range(arr.shape[0]))
+    return [
+        sum(int(arr[i, n]) << (BITS * i) for i in range(arr.shape[0]))
+        for n in range(arr.shape[1])
+    ]
+
+
+def splat(x: int, n: int) -> jnp.ndarray:
+    """Broadcast a constant element across an N-batch."""
+    return jnp.tile(jnp.asarray(to_limbs(x))[:, None], (1, n))
+
+
+# Bias for subtraction: 1024*p in a redundant representation whose every
+# limb is >= 8189 > REDUCED bound, so (a + BIAS - b) is limb-wise
+# non-negative for any REDUCED a, b. Derivation: canonical limbs of
+# 1024p = 2^265 - 19456 are [1024, 4091, 4095*19, 8191 (incl. the 2^264
+# bit)]; add 8192 to limbs 0..20 and subtract 2 from limbs 1..21
+# (value-preserving redistribution).
+def _make_sub_bias() -> np.ndarray:
+    c = np.zeros(NLIMB, np.int64)
+    v = 1024 * P
+    for i in range(NLIMB):
+        c[i] = v & MASK
+        v >>= BITS
+    c[21] += v << BITS  # 1024p = 2^265 - 19456: fold the 2^264 bit into limb 21
+    b = c.copy()
+    b[:21] += 8192
+    b[1:] -= 2
+    assert (b >= 8189).all() and b.max() < 1 << 15
+    assert sum(int(b[i]) << (BITS * i) for i in range(NLIMB)) == 1024 * P
+    return b.astype(np.int32)
+
+
+_SUB_BIAS = _make_sub_bias()
+
+
+def _fold_top(r: jnp.ndarray, ctop: jnp.ndarray) -> jnp.ndarray:
+    """Fold a carry of weight 2^264 back in as 19*c at bit 9.
+
+    Split across limbs 0 and 1 so the added values stay small:
+    19*c * 2^9 = ((19c) & 7) * 2^9  +  ((19c) >> 3) * 2^12.
+    Safe for ctop up to ~5e7.
+    """
+    t = ctop * 19
+    r = r.at[0].add((t & 7) << 9)
+    r = r.at[1].add(t >> 3)
+    return r
+
+
+def _pass22(x: jnp.ndarray) -> jnp.ndarray:
+    """One parallel carry pass over 22 limbs with top fold.
+
+    Arithmetic (signed) shift, so negative limbs borrow correctly.
+    """
+    c = x >> BITS
+    r = x & MASK
+    r = r.at[1:].add(c[:-1])
+    return _fold_top(r, c[-1])
+
+
+REDUCED_BOUND = 7700
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """REDUCED + REDUCED -> REDUCED."""
+    return _pass22(jnp.asarray(a) + jnp.asarray(b))
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """REDUCED - REDUCED -> REDUCED. Adds 1024p so limbs stay >= 0."""
+    return _pass22(jnp.asarray(a) + jnp.asarray(_SUB_BIAS)[:, None] - jnp.asarray(b))
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return _pass22(jnp.asarray(_SUB_BIAS)[:, None] - jnp.asarray(a))
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field multiply. Inputs REDUCED (limbs < 7700); output REDUCED.
+
+    Schoolbook over 22 limbs (columns <= 1.31e9 < 2^31), one exact-carry
+    extension pass to 12-bit limbs, split fold of the top 22 limbs by
+    2^264 ≡ 19*2^9, then three parallel carry passes. Bound chain is in
+    the module docstring; tests drive randomized near-max patterns.
+    """
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    n = a.shape[-1]
+    c = jnp.zeros((2 * NLIMB - 1, n), jnp.int32)
+    for i in range(NLIMB):
+        c = c.at[i : i + NLIMB].add(a[i] * b)
+    return _reduce43(c)
+
+
+def sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def _reduce43(c: jnp.ndarray) -> jnp.ndarray:
+    """(43, N) schoolbook columns (each < 2^31) -> REDUCED (22, N)."""
+    # Pass 1: carry into 44 limbs; carries <= 1.31e9 >> 12 ≈ 3.2e5.
+    cc = c >> BITS
+    r = c & MASK
+    r = r.at[1:].add(cc[:-1])
+    r = jnp.concatenate([r, cc[-1:]], axis=0)  # (44, N)
+    # Fold: limb (22+m) has weight 2^264 * 2^(12m) ≡ 19*2^9 * 2^(12m).
+    # Split so nothing overflows: 19*hi * 2^9 = ((19h)&7)<<9 at limb m
+    # plus (19h)>>3 at limb m+1; the m=21 spill (weight 2^264 again)
+    # folds once more — it is small (<= ~1.5e7) by then.
+    t = r[NLIMB:] * 19  # <= 19 * 3.3e5 ≈ 6.3e6
+    d = r[:NLIMB]
+    d = d + ((t & 7) << 9)
+    d = d.at[1:].add(t[:-1] >> 3)
+    t2 = (t[-1] >> 3) * 19
+    d = d.at[0].add((t2 & 7) << 9)
+    d = d.at[1].add(t2 >> 3)
+    # Three parallel passes: ~3e6 -> ~8.6e3 -> REDUCED.
+    d = _pass22(d)
+    d = _pass22(d)
+    d = _pass22(d)
+    return d
+
+
+def _ripple22(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact sequential carry: limbs in [0, 4096) plus signed out-carry."""
+
+    def step(carry, limb):
+        v = limb + carry
+        return v >> BITS, v & MASK
+
+    out_c, limbs = jax.lax.scan(step, jnp.zeros(x.shape[-1], jnp.int32), x)
+    return limbs, out_c
+
+
+def canonical(x: jnp.ndarray) -> jnp.ndarray:
+    """Unique representative in [0, p) with 12-bit limbs. Off hot path."""
+    l1, c1 = _ripple22(x)  # c1 in [0, 4] for REDUCED-ish input
+    l1 = _fold_top(l1, c1)
+    l2, _ = _ripple22(l1)  # value now < 2^264, carry 0
+    # Reduce 264 -> 255 bits: bits 255.. of limb 21 re-enter as *19.
+    hi = l2[21] >> 3
+    l2 = l2.at[21].set(l2[21] & 7)
+    l2 = l2.at[0].add(hi * 19)
+    l3, _ = _ripple22(l2)  # value < 2^255 + 9728 < 2p
+    # Conditional subtract: value >= p  iff  value + 19 >= 2^255.
+    t = l3.at[0].add(19)
+    t4, _ = _ripple22(t)
+    ge = (t4[21] >> 3) > 0
+    sub_p = t4.at[21].set(t4[21] & 7)
+    return jnp.where(ge, sub_p, l3)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Per-lane equality mod p -> (N,) bool."""
+    return is_zero(sub(a, b))
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(canonical(a) == 0, axis=0)
+
+
+def parity(a: jnp.ndarray) -> jnp.ndarray:
+    """Low bit of the canonical representative -> (N,) int32 in {0,1}."""
+    return canonical(a)[0] & 1
+
+
+def nsquare(a: jnp.ndarray, n: int) -> jnp.ndarray:
+    """a^(2^n) via n squarings (lax loop: compile body once)."""
+    return jax.lax.fori_loop(0, n, lambda _, x: sqr(x), a)
+
+
+def pow_2_252_m3(z: jnp.ndarray) -> jnp.ndarray:
+    """z^(2^252 - 3) — the exponent for sqrt(u/v) in decompression.
+
+    Standard ed25519 addition chain (11 multiplies + 252 squarings).
+    """
+    z2 = sqr(z)
+    z9 = mul(sqr(sqr(z2)), z)
+    z11 = mul(z9, z2)
+    z_5_0 = mul(sqr(z11), z9)  # 2^5 - 1
+    z_10_0 = mul(nsquare(z_5_0, 5), z_5_0)
+    z_20_0 = mul(nsquare(z_10_0, 10), z_10_0)
+    z_40_0 = mul(nsquare(z_20_0, 20), z_20_0)
+    z_50_0 = mul(nsquare(z_40_0, 10), z_10_0)
+    z_100_0 = mul(nsquare(z_50_0, 50), z_50_0)
+    z_200_0 = mul(nsquare(z_100_0, 100), z_100_0)
+    z_250_0 = mul(nsquare(z_200_0, 50), z_50_0)
+    return mul(nsquare(z_250_0, 2), z)
+
+
+# Curve constants (as Python ints; modules build jnp consts from these).
+D = (-121665 * pow(121666, P - 2, P)) % P
+D2 = (2 * D) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)

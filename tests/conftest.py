@@ -7,7 +7,7 @@ CPU for determinism and to exercise multi-chip sharding paths.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # override any inherited axon/tpu setting
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,3 +17,9 @@ if "xla_force_host_platform_device_count" not in flags:
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The machine's axon sitecustomize force-registers the TPU plugin; the
+# config update (not just the env var) is what actually wins.
+import jax
+
+jax.config.update("jax_platforms", "cpu")

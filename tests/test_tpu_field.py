@@ -1,0 +1,138 @@
+"""Property tests: JAX limb field arithmetic vs Python big-int ground truth."""
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto.tpu import field as fe
+
+P = fe.P
+RNG = np.random.default_rng(1234)
+
+
+def rand_elems(n, bound=None):
+    """Random REDUCED limb batch (22, n) + matching Python ints."""
+    bound = bound or fe.REDUCED_BOUND
+    limbs = RNG.integers(0, bound, size=(fe.NLIMB, n), dtype=np.int64)
+    vals = fe.from_limbs(limbs)
+    return limbs.astype(np.int32), vals
+
+
+def adversarial_elems():
+    """Near-max patterns: all limbs at the REDUCED bound, zeros, p, 2p-ish."""
+    cols = [
+        np.full(fe.NLIMB, fe.REDUCED_BOUND - 1),
+        np.zeros(fe.NLIMB),
+        np.full(fe.NLIMB, 4095),
+        fe.to_limbs(P),
+        fe.to_limbs(2 * P),
+        fe.to_limbs(P - 1),
+        fe.to_limbs(P + 1),
+        fe.to_limbs(1),
+        fe.to_limbs((1 << 264) - 1),
+        fe.to_limbs(19),
+    ]
+    limbs = np.stack(cols, axis=1).astype(np.int32)
+    return limbs, fe.from_limbs(limbs)
+
+
+def test_to_from_limbs_roundtrip():
+    for v in [0, 1, 19, P - 1, P, P + 1, 2**255 - 1, 2**264 - 1]:
+        assert fe.from_limbs(fe.to_limbs(v)) == v
+
+
+@pytest.mark.parametrize("op,pyop", [("add", lambda a, b: a + b), ("sub", lambda a, b: a - b)])
+def test_add_sub(op, pyop):
+    a_l, a_v = rand_elems(64)
+    b_l, b_v = rand_elems(64)
+    out = np.asarray(getattr(fe, op)(a_l, b_l))
+    assert out.max() < fe.REDUCED_BOUND and out.min() >= 0, f"{op} broke REDUCED bound"
+    for got, av, bv in zip(fe.from_limbs(out), a_v, b_v):
+        assert got % P == pyop(av, bv) % P
+
+
+def test_mul_random():
+    a_l, a_v = rand_elems(128)
+    b_l, b_v = rand_elems(128)
+    out = np.asarray(fe.mul(a_l, b_l))
+    assert out.max() < fe.REDUCED_BOUND and out.min() >= 0, "mul broke REDUCED bound"
+    for got, av, bv in zip(fe.from_limbs(out), a_v, b_v):
+        assert got % P == (av * bv) % P
+
+
+def test_mul_adversarial():
+    a_l, a_v = adversarial_elems()
+    # all pairs
+    n = a_l.shape[1]
+    ii, jj = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+    aa = a_l[:, ii.ravel()]
+    bb = a_l[:, jj.ravel()]
+    out = np.asarray(fe.mul(aa, bb))
+    assert out.max() < fe.REDUCED_BOUND and out.min() >= 0
+    got = fe.from_limbs(out)
+    for idx, (i, j) in enumerate(zip(ii.ravel(), jj.ravel())):
+        assert got[idx] % P == (a_v[i] * a_v[j]) % P
+
+
+def test_sub_never_negative_intermediate():
+    # max b against min a — the bias must keep every limb non-negative
+    a = np.zeros((fe.NLIMB, 1), np.int32)
+    b = np.full((fe.NLIMB, 1), fe.REDUCED_BOUND - 1, np.int32)
+    out = np.asarray(fe.sub(a, b))
+    assert out.min() >= 0
+    assert fe.from_limbs(out)[0] % P == (0 - fe.from_limbs(b)[0]) % P
+
+
+def test_canonical():
+    a_l, a_v = adversarial_elems()
+    out = np.asarray(fe.canonical(a_l))
+    for got, v in zip(fe.from_limbs(out), a_v):
+        assert got == v % P
+        assert 0 <= got < P
+    r_l, r_v = rand_elems(64)
+    out = np.asarray(fe.canonical(r_l))
+    for got, v in zip(fe.from_limbs(out), r_v):
+        assert got == v % P
+
+
+def test_eq_and_is_zero():
+    one = fe.splat(1, 4)
+    p_plus_1 = fe.splat(P + 1, 4)
+    assert np.asarray(fe.eq(one, p_plus_1)).all(), "1 != p+1 mod p?"
+    assert np.asarray(fe.is_zero(fe.splat(P, 3))).all()
+    assert not np.asarray(fe.is_zero(fe.splat(1, 3))).any()
+
+
+def test_parity():
+    # parity is of the canonical representative: p+1 ≡ 1 -> odd
+    assert np.asarray(fe.parity(fe.splat(P + 1, 2)))[0] == 1
+    assert np.asarray(fe.parity(fe.splat(P, 2)))[0] == 0
+    assert np.asarray(fe.parity(fe.splat(4, 2)))[0] == 0
+
+
+def test_pow_2_252_m3():
+    a_l, a_v = rand_elems(16)
+    out = fe.from_limbs(np.asarray(fe.pow_2_252_m3(a_l)))
+    e = (1 << 252) - 3
+    for got, v in zip(out, a_v):
+        assert got % P == pow(v % P, e, P)
+
+
+def test_neg():
+    a_l, a_v = rand_elems(32)
+    out = fe.from_limbs(np.asarray(fe.neg(a_l)))
+    for got, v in zip(out, a_v):
+        assert got % P == (-v) % P
+
+
+def test_mul_chain_stability():
+    """Repeated squaring keeps the REDUCED bound (no drift)."""
+    a_l, a_v = rand_elems(8)
+    x = a_l
+    v = list(a_v)
+    for _ in range(50):
+        x = fe.sqr(x)
+        v = [(t * t) % P for t in v]
+    x = np.asarray(x)
+    assert x.max() < fe.REDUCED_BOUND and x.min() >= 0
+    for got, want in zip(fe.from_limbs(x), v):
+        assert got % P == want

@@ -1,0 +1,176 @@
+"""Golden tests: TPU batch kernel vs the pure-Python ZIP-215 oracle."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto import ed25519_ref as ref
+from tendermint_tpu.crypto.tpu import edwards as ed
+from tendermint_tpu.crypto.tpu import field as fe
+from tendermint_tpu.crypto.tpu import verify as tv
+
+P = ref.P
+
+
+def _pt_to_limbs(pt, n=1):
+    x, y = pt
+    return ed.Point(
+        fe.splat(x, n), fe.splat(y, n), fe.splat(1, n), fe.splat((x * y) % P, n)
+    )
+
+
+def _limbs_to_affine(p: ed.Point, lane=0):
+    x, y, z, _ = (fe.from_limbs(np.asarray(c))[lane] for c in p)
+    zi = pow(z, P - 2, P)
+    return (x * zi) % P, (y * zi) % P
+
+
+class TestPointOps:
+    def test_add_double_vs_oracle(self):
+        rng = np.random.default_rng(7)
+        a = ref.scalar_mult(12345, ref._B_PT)
+        b = ref.scalar_mult(99999, ref._B_PT)
+        pa = _pt_to_limbs(ref.from_extended(a))
+        pb = _pt_to_limbs(ref.from_extended(b))
+        got = _limbs_to_affine(ed.add(pa, pb))
+        want = ref.from_extended(ref.pt_add(a, b))
+        assert got == want
+        got_d = _limbs_to_affine(ed.double(pa))
+        want_d = ref.from_extended(ref.pt_double(a))
+        assert got_d == want_d
+
+    def test_identity_cases(self):
+        idp = ed.identity(2)
+        assert np.asarray(ed.is_identity(idp)).all()
+        b = _pt_to_limbs(ref.from_extended(ref._B_PT), 2)
+        assert not np.asarray(ed.is_identity(b)).any()
+        # B + identity = B (complete formula handles identity)
+        got = _limbs_to_affine(ed.add(b, ed.identity(2)))
+        assert got == ref.from_extended(ref._B_PT)
+        # B + (-B) = identity
+        assert np.asarray(ed.is_identity(ed.add(b, ed.neg(b)))).all()
+        # doubling the identity stays identity
+        assert np.asarray(ed.is_identity(ed.double(ed.identity(2)))).all()
+
+    def test_order2_point_not_identity(self):
+        # (0, -1) has X=0 but Y != Z
+        p = _pt_to_limbs((0, P - 1), 2)
+        assert not np.asarray(ed.is_identity(p)).any()
+
+    def test_decompress_vs_oracle(self):
+        encs = []
+        for i in range(16):
+            pt = ref.scalar_mult(1000 + i, ref._B_PT)
+            encs.append(ref.compress(ref.from_extended(pt)))
+        encs.append((ref.P + 1).to_bytes(32, "little"))  # non-canonical identity
+        encs.append((1 | (1 << 255)).to_bytes(32, "little"))  # x=0 sign=1
+        encs.append((2).to_bytes(32, "little"))  # off-curve
+        encs.append((ref.P - 1).to_bytes(32, "little"))  # order-2 point
+        n = len(encs)
+        arr = np.frombuffer(b"".join(encs), np.uint8).reshape(n, 32)
+        sign = (arr[:, 31] >> 7).astype(np.int32)
+        ybytes = arr.copy()
+        ybytes[:, 31] &= 0x7F
+        pt, ok = ed.decompress(tv._bytes32_to_limbs(ybytes), sign)
+        ok = np.asarray(ok)
+        for i, enc in enumerate(encs):
+            want = ref.decompress(enc)
+            assert ok[i] == (want is not None), f"lane {i}"
+            if want is not None:
+                assert _limbs_to_affine(pt, i) == (want[0] % P, want[1] % P), f"lane {i}"
+
+
+def _sig_batch():
+    """A batch exercising valid, invalid and every ZIP-215 edge case."""
+    pubs, msgs, sigs = [], [], []
+
+    def emit(p, m, s):
+        pubs.append(p)
+        msgs.append(m)
+        sigs.append(s)
+
+    for i in range(8):
+        seed = hashlib.sha256(b"batch%d" % i).digest()
+        pub = ref.public_key_from_seed(seed)
+        msg = b"message %d" % i
+        emit(pub, msg, ref.sign(seed, msg))
+
+    seed = hashlib.sha256(b"evil").digest()
+    pub = ref.public_key_from_seed(seed)
+    good = ref.sign(seed, b"ok")
+    emit(pub, b"tampered", good)  # wrong msg
+    bad = bytearray(good)
+    bad[1] ^= 0xFF
+    emit(pub, b"ok", bytes(bad))  # corrupt R
+    bad2 = bytearray(good)
+    bad2[40] ^= 1
+    emit(pub, b"ok", bytes(bad2))  # corrupt S
+    # S >= L
+    s_int = int.from_bytes(good[32:], "little")
+    if s_int + ref.L < 2**256:
+        emit(pub, b"ok", good[:32] + (s_int + ref.L).to_bytes(32, "little"))
+    # non-canonical small-order R (ZIP-215-only accept)
+    h = hashlib.sha512(seed).digest()
+    a = ref._clamp(h)
+    r_enc = (ref.P + 1).to_bytes(32, "little")
+    k = int.from_bytes(hashlib.sha512(r_enc + pub + b"nc").digest(), "little") % ref.L
+    emit(pub, b"nc", r_enc + ((k * a) % ref.L).to_bytes(32, "little"))
+    # canonical small-order R (identity)
+    r_enc2 = (1).to_bytes(32, "little")
+    k2 = int.from_bytes(hashlib.sha512(r_enc2 + pub + b"so").digest(), "little") % ref.L
+    emit(pub, b"so", r_enc2 + ((k2 * a) % ref.L).to_bytes(32, "little"))
+    # off-curve A
+    emit((2).to_bytes(32, "little"), b"x", good)
+    # wrong-length pub and sig (host pre-screen)
+    emit(b"\x01" * 31, b"x", good)
+    emit(pub, b"x", good[:40])
+    # empty message valid sig
+    emit(pub, b"", ref.sign(seed, b""))
+    return pubs, msgs, sigs
+
+
+def test_batch_verify_matches_oracle():
+    pubs, msgs, sigs = _sig_batch()
+    got = tv.verify_batch(pubs, msgs, sigs)
+    want = np.array(
+        [
+            len(p) == 32 and len(s) == 64 and ref.verify(p, m, s)
+            for p, m, s in zip(pubs, msgs, sigs)
+        ]
+    )
+    assert got.tolist() == want.tolist()
+    assert want[:8].all(), "sanity: the first 8 must be valid"
+    assert want.sum() >= 10 and (~want).sum() >= 5, "need both classes"
+
+
+def test_batch_verify_randomized_against_oracle():
+    rng = np.random.default_rng(42)
+    pubs, msgs, sigs = [], [], []
+    for i in range(64):
+        seed = hashlib.sha256(b"rand%d" % i).digest()
+        pub = ref.public_key_from_seed(seed)
+        msg = bytes(rng.integers(0, 256, size=int(rng.integers(0, 100)), dtype=np.uint8))
+        sig = ref.sign(seed, msg)
+        if i % 5 == 0:  # corrupt a random byte somewhere
+            which = int(rng.integers(0, 3))
+            if which == 0:
+                msg = msg + b"!"
+            elif which == 1:
+                b = bytearray(sig)
+                b[int(rng.integers(0, 64))] ^= 1 << int(rng.integers(0, 8))
+                sig = bytes(b)
+            else:
+                b = bytearray(pub)
+                b[int(rng.integers(0, 32))] ^= 1 << int(rng.integers(0, 8))
+                pub = bytes(b)
+        pubs.append(pub)
+        msgs.append(msg)
+        sigs.append(sig)
+    got = tv.verify_batch(pubs, msgs, sigs)
+    want = [ref.verify(p, m, s) for p, m, s in zip(pubs, msgs, sigs)]
+    assert got.tolist() == want
+
+
+def test_empty_batch():
+    assert tv.verify_batch([], [], []).shape == (0,)
