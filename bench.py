@@ -1,0 +1,101 @@
+"""Headline benchmark: 10k-validator Commit signature verification.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+The metric is p50 latency of verifying a 10,240-signature commit batch
+(10k validators, BASELINE.json config #5) on the default JAX device.
+vs_baseline = speedup over the reference's execution model: a
+sequential single-core CPU verify loop (types/validator_set.go:683-705)
+measured here with OpenSSL ed25519 (a *fast* CPU baseline — the
+reference's pure-Go verifier is slower).
+"""
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    import numpy as np
+
+    from tendermint_tpu.crypto.tpu import verify as tv
+
+    n = 10240  # 10k validators, one CommitSig each
+    baseline_estimated = False
+    try:
+        from cryptography.hazmat.primitives import serialization
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey,
+        )
+
+        keys = [
+            Ed25519PrivateKey.from_private_bytes(
+                hashlib.sha256(b"bench%d" % i).digest()
+            )
+            for i in range(n)
+        ]
+        pubs = [
+            k.public_key().public_bytes(
+                serialization.Encoding.Raw, serialization.PublicFormat.Raw
+            )
+            for k in keys
+        ]
+        msgs = [b"precommit h=1234 r=0 block=deadbeef val=%d" % i for i in range(n)]
+        sigs = [k.sign(m) for k, m in zip(keys, msgs)]
+
+        # CPU baseline: sequential strict verify, single core (OpenSSL).
+        sample = 256
+        t0 = time.perf_counter()
+        for i in range(sample):
+            keys[i].public_key().verify(sigs[i], msgs[i])
+        cpu_per_sig = (time.perf_counter() - t0) / sample
+    except ImportError:  # pragma: no cover
+        baseline_estimated = True
+        from tendermint_tpu.crypto import ed25519_ref as ref
+
+        pubs, msgs, sigs = [], [], []
+        for i in range(n):
+            seed = hashlib.sha256(b"bench%d" % i).digest()
+            pubs.append(ref.public_key_from_seed(seed))
+            msgs.append(b"precommit %d" % i)
+            sigs.append(ref.sign(seed, msgs[-1]))
+        cpu_per_sig = 100e-6  # nominal estimate, flagged below
+
+    cpu_batch_s = cpu_per_sig * n
+
+    # Warm up (compiles the bucket); then measure.
+    out = tv.verify_batch(pubs, msgs, sigs)
+    assert bool(out.all()), "bench batch must verify"
+    times = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        out = tv.verify_batch(pubs, msgs, sigs)
+        times.append(time.perf_counter() - t0)
+    p50 = sorted(times)[len(times) // 2]
+
+    import jax
+
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_commit_verify_p50_10k_vals",
+                "value": round(p50 * 1e3, 3),
+                "unit": "ms",
+                "vs_baseline": round(cpu_batch_s / p50, 2),
+                "sigs_per_sec": round(n / p50),
+                "batch": n,
+                "device": str(jax.devices()[0]),
+                "cpu_baseline_us_per_sig": round(cpu_per_sig * 1e6, 1),
+                "baseline_estimated": baseline_estimated,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

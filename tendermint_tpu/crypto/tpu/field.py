@@ -149,7 +149,22 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def sqr(a: jnp.ndarray) -> jnp.ndarray:
-    return mul(a, a)
+    """Dedicated squaring: ~half the limb products of a general mul.
+
+    Columns c[i+j] = sum 2*a_i*a_j (i<j) + a_i^2. Overflow bound per
+    column: an odd column has at most 11 doubled pairs (22*7699^2 =
+    1.304e9); an even column has at most 10 doubled pairs plus one
+    square term (21*7699^2 = 1.245e9); both < 2^31.
+    """
+    a = jnp.asarray(a)
+    n = a.shape[-1]
+    a2 = a + a
+    c = jnp.zeros((2 * NLIMB - 1, n), jnp.int32)
+    for i in range(NLIMB):
+        c = c.at[2 * i].add(a[i] * a[i])
+        if i + 1 < NLIMB:
+            c = c.at[2 * i + 1 : i + NLIMB].add(a2[i] * a[i + 1 :])
+    return _reduce43(c)
 
 
 def _reduce43(c: jnp.ndarray) -> jnp.ndarray:

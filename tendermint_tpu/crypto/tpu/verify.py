@@ -163,11 +163,27 @@ def _dummy_triple() -> tuple[bytes, bytes, bytes]:
     return (pub, msg, ref.sign(seed, msg))
 
 
-def _bucket(n: int) -> int:
-    b = _MIN_BATCH
-    while b < n:
-        b <<= 1
-    return b
+def _chunks(n: int) -> list[int]:
+    """Split n into power-of-two kernel launches so a 10,240-sig commit
+    runs as 8192+2048 instead of padding to 16384, while batch sizes
+    just under a bucket (e.g. 32767) pad into ONE launch rather than
+    fragmenting into up to 9: accept a bucket whenever padding waste is
+    <= 1/8 of it."""
+    out = []
+    while n > 0:
+        if n >= _MAX_BATCH:
+            out.append(_MAX_BATCH)
+            n -= _MAX_BATCH
+            continue
+        up = _MIN_BATCH
+        while up < n:
+            up <<= 1
+        if up - n <= up >> 3 or up == _MIN_BATCH:
+            out.append(up)
+            return out
+        out.append(up >> 1)
+        n -= up >> 1
+    return out
 
 
 def verify_batch(pubs, msgs, sigs) -> np.ndarray:
@@ -192,17 +208,18 @@ def verify_batch(pubs, msgs, sigs) -> np.ndarray:
         sigs = [s if ok else ds for s, ok in zip(sigs, well_formed)]
 
     out = np.empty(n, bool)
-    for start in range(0, n, _MAX_BATCH):
-        end = min(start + _MAX_BATCH, n)
+    start = 0
+    for size in _chunks(n):
+        end = min(start + size, n)
         out[start:end] = _verify_chunk(
-            pubs[start:end], msgs[start:end], sigs[start:end]
+            pubs[start:end], msgs[start:end], sigs[start:end], size
         )
+        start = end
     return out & well_formed
 
 
-def _verify_chunk(pubs, msgs, sigs) -> np.ndarray:
+def _verify_chunk(pubs, msgs, sigs, bucket: int) -> np.ndarray:
     n = len(pubs)
-    bucket = _bucket(n)
     if bucket > n:
         dp, dm, ds = _dummy_triple()
         pad = bucket - n

@@ -1,0 +1,75 @@
+"""BatchVerifier public API tests."""
+
+import hashlib
+
+import numpy as np
+
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.crypto.batch import BatchVerifier
+from tendermint_tpu.crypto.tpu import verify as tv
+
+
+def _signed(n, tag=b"bv"):
+    out = []
+    for i in range(n):
+        priv = ed25519.Ed25519PrivKey(hashlib.sha256(tag + b"%d" % i).digest())
+        msg = b"m%d" % i
+        out.append((priv.pub_key(), msg, priv.sign(msg)))
+    return out
+
+
+def test_empty():
+    ok, lanes = BatchVerifier().verify()
+    assert ok and lanes.shape == (0,)
+
+
+def test_small_batch_host_path():
+    bv = BatchVerifier()
+    for pk, m, s in _signed(5):
+        bv.add(pk, m, s)
+    ok, lanes = bv.verify()
+    assert ok and lanes.all() and len(lanes) == 5
+
+
+def test_mixed_verdicts_order_preserved():
+    bv = BatchVerifier()
+    items = _signed(6)
+    for i, (pk, m, s) in enumerate(items):
+        if i in (1, 4):
+            m = m + b"!"
+        bv.add(pk, m, s)
+    ok, lanes = bv.verify()
+    assert not ok
+    assert lanes.tolist() == [True, False, True, True, False, True]
+
+
+def test_device_path_threshold():
+    bv = BatchVerifier()
+    for pk, m, s in _signed(20):
+        bv.add(pk, m, s)
+    ok, lanes = bv.verify()
+    assert ok and len(lanes) == 20
+
+
+def test_chunks_split():
+    assert tv._chunks(10240) == [8192, 2048]
+    assert tv._chunks(128) == [128]
+    assert tv._chunks(100) == [128]
+    assert tv._chunks(129) == [128, 128]
+    assert tv._chunks(1 << 15) == [1 << 15]
+    assert tv._chunks((1 << 15) - 1) == [1 << 15]  # pad 1, one launch
+    assert tv._chunks((1 << 15) + 5) == [1 << 15, 128]
+    assert tv._chunks(15000) == [16384]  # waste 1384 <= 2048 -> single launch
+    for n in [1, 127, 300, 1000, 5000, 10240, 33000]:
+        ch = tv._chunks(n)
+        assert sum(ch) >= n
+        # only the final chunk may pad
+        assert all(c <= rem for c, rem in zip(ch[:-1], _remainders(n, ch)))
+
+
+def _remainders(n, chunks):
+    out = []
+    for c in chunks:
+        out.append(n)
+        n -= c
+    return out
