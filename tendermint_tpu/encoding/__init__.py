@@ -1,0 +1,12 @@
+"""Deterministic wire encoding.
+
+A hand-rolled protobuf wire-format writer/reader (varint, fixed64,
+length-delimited). Canonical sign-bytes (types/canonical.py) are built
+on this so that two nodes always produce byte-identical messages to
+sign — the property the reference gets from gogoproto's canonical
+marshalling (reference: types/canonical.go, proto/tendermint/).
+"""
+
+from .proto import Reader, Writer, decode_varint, encode_varint
+
+__all__ = ["Writer", "Reader", "encode_varint", "decode_varint"]

@@ -1,0 +1,2 @@
+"""Support libraries (reference capability: libs/ — service lifecycle,
+logging, pubsub with query DSL, bit arrays, rate limiting, failpoints)."""

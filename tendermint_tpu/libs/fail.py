@@ -1,0 +1,27 @@
+"""Failpoint crash injection (reference: libs/fail/fail.go).
+
+Set FAIL_TEST_INDEX=<n>: the n-th fail() call-site reached in this
+process exits hard (os._exit, no cleanup — simulating a crash). Used by
+crash-recovery tests around the WAL and ApplyBlock persistence steps.
+"""
+
+from __future__ import annotations
+
+import os
+
+_counter = -1
+
+
+def fail() -> None:
+    global _counter
+    env = os.environ.get("FAIL_TEST_INDEX")
+    if env is None:
+        return
+    _counter += 1
+    if _counter == int(env):
+        os._exit(1)
+
+
+def reset() -> None:
+    global _counter
+    _counter = -1

@@ -1,0 +1,37 @@
+"""Core consensus types (reference capability: types/ — ~12.7k LoC).
+
+Block/Header/Commit, Vote/VoteSet, ValidatorSet, Evidence, genesis,
+events, canonical sign-bytes. All signature verification funnels
+through crypto.batch.BatchVerifier (the capability the reference
+lacks — its call sites are one-at-a-time synchronous verifies at
+types/vote_set.go:203 and types/validator_set.go:683-705).
+"""
+
+from .block import (
+    Block,
+    BlockID,
+    BlockIDFlag,
+    Commit,
+    CommitSig,
+    Data,
+    Header,
+    PartSetHeader,
+)
+from .evidence import DuplicateVoteEvidence, Evidence, EvidenceData
+from .genesis import GenesisDoc
+from .params import ConsensusParams
+from .priv_validator import MockPV, PrivValidator
+from .proposal import Proposal
+from .tx import Tx, tx_hash, txs_hash
+from .validator import Validator
+from .validator_set import ValidatorSet
+from .vote import Vote, VoteType
+from .vote_set import VoteSet
+
+__all__ = [
+    "Block", "BlockID", "BlockIDFlag", "Commit", "CommitSig", "Data",
+    "Header", "PartSetHeader", "DuplicateVoteEvidence", "Evidence",
+    "EvidenceData", "GenesisDoc", "ConsensusParams", "MockPV",
+    "PrivValidator", "Proposal", "Tx", "tx_hash", "txs_hash",
+    "Validator", "ValidatorSet", "Vote", "VoteType", "VoteSet",
+]

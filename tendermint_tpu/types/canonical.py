@@ -1,0 +1,74 @@
+"""Canonical sign-bytes (reference: types/canonical.go).
+
+The bytes a validator signs for votes and proposals. Deterministic
+protobuf wire encoding, length-delimited (varint length prefix), field
+numbers and types mirroring the reference's canonical.proto:
+
+  CanonicalVote     { type=1 varint; height=2 sfixed64; round=3 sfixed64;
+                      block_id=4; timestamp=5; chain_id=6 }
+  CanonicalProposal { type=1; height=2 sfixed64; round=3 sfixed64;
+                      pol_round=4 varint; block_id=5; timestamp=6;
+                      chain_id=7 }
+  CanonicalBlockID  { hash=1; part_set_header=2 }
+  CanonicalPartSetHeader { total=1 varint; hash=2 }
+  Timestamp         { seconds=1 varint; nanos=2 varint }
+
+Zero-valued scalars are skipped (proto3 canonical form); a nil BlockID
+encodes as an absent field.
+"""
+
+from __future__ import annotations
+
+from ..encoding.proto import Writer, encode_varint
+
+
+def timestamp_writer(time_ns: int) -> Writer | None:
+    if time_ns == 0:
+        return None
+    w = Writer()
+    w.varint(1, time_ns // 1_000_000_000)
+    w.varint(2, time_ns % 1_000_000_000)
+    return w
+
+
+def canonical_block_id_writer(block_id) -> Writer | None:
+    """block_id: types.block.BlockID or None."""
+    if block_id is None or block_id.is_nil():
+        return None
+    w = Writer()
+    w.bytes(1, block_id.hash)
+    psh = block_id.part_set_header
+    if psh is not None and not psh.is_zero():
+        pw = Writer()
+        pw.varint(1, psh.total)
+        pw.bytes(2, psh.hash)
+        w.message(2, pw)
+    return w
+
+
+def vote_sign_bytes(chain_id: str, vote_type: int, height: int, round_: int,
+                    block_id, time_ns: int) -> bytes:
+    w = Writer()
+    w.varint(1, vote_type)
+    w.sfixed64(2, height)
+    w.sfixed64(3, round_)
+    w.message(4, canonical_block_id_writer(block_id))
+    w.message(5, timestamp_writer(time_ns))
+    w.string(6, chain_id)
+    body = w.finish()
+    return encode_varint(len(body)) + body
+
+
+def proposal_sign_bytes(chain_id: str, height: int, round_: int,
+                        pol_round: int, block_id, time_ns: int) -> bytes:
+    w = Writer()
+    w.varint(1, 32)  # SignedMsgType PROPOSAL
+    w.sfixed64(2, height)
+    w.sfixed64(3, round_)
+    # pol_round is -1 when absent; encodes as int64 two's complement.
+    w.varint(4, pol_round)
+    w.message(5, canonical_block_id_writer(block_id))
+    w.message(6, timestamp_writer(time_ns))
+    w.string(7, chain_id)
+    body = w.finish()
+    return encode_varint(len(body)) + body

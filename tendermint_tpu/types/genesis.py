@@ -1,0 +1,119 @@
+"""Genesis document (reference: types/genesis.go)."""
+
+from __future__ import annotations
+
+import json
+import time as _time
+from dataclasses import dataclass, field
+
+from .. import crypto
+from ..crypto import tmhash
+from .params import ConsensusParams
+from .validator import Validator
+
+MAX_CHAIN_ID_LEN = 50
+
+
+@dataclass
+class GenesisValidator:
+    pub_key: crypto.PubKey
+    power: int
+    name: str = ""
+
+    @property
+    def address(self) -> bytes:
+        return self.pub_key.address()
+
+
+@dataclass
+class GenesisDoc:
+    chain_id: str
+    genesis_time: int = 0  # ns
+    initial_height: int = 1
+    consensus_params: ConsensusParams = field(default_factory=ConsensusParams)
+    validators: list[GenesisValidator] = field(default_factory=list)
+    app_hash: bytes = b""
+    app_state: dict | list | str | None = None
+
+    def validate_and_complete(self) -> None:
+        if not self.chain_id or len(self.chain_id) > MAX_CHAIN_ID_LEN:
+            raise ValueError("bad chain id")
+        if self.initial_height < 0:
+            raise ValueError("negative initial height")
+        if self.initial_height == 0:
+            self.initial_height = 1
+        self.consensus_params.validate_basic()
+        for v in self.validators:
+            if v.power < 0:
+                raise ValueError("negative validator power")
+        if self.genesis_time == 0:
+            self.genesis_time = _time.time_ns()
+
+    def validator_set(self):
+        from .validator_set import ValidatorSet
+
+        return ValidatorSet(
+            [Validator.new(v.pub_key, v.power) for v in self.validators]
+        )
+
+    def hash(self) -> bytes:
+        return tmhash.sum256(self.to_json().encode())
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "chain_id": self.chain_id,
+                "genesis_time": self.genesis_time,
+                "initial_height": self.initial_height,
+                "consensus_params": self.consensus_params.to_json(),
+                "validators": [
+                    {
+                        "pub_key": {
+                            "type": v.pub_key.type_name,
+                            "value": v.pub_key.bytes().hex(),
+                        },
+                        "power": v.power,
+                        "name": v.name,
+                    }
+                    for v in self.validators
+                ],
+                "app_hash": self.app_hash.hex(),
+                "app_state": self.app_state,
+            },
+            sort_keys=True,
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "GenesisDoc":
+        d = json.loads(s)
+        doc = cls(
+            chain_id=d["chain_id"],
+            genesis_time=d.get("genesis_time", 0),
+            initial_height=d.get("initial_height", 1),
+            consensus_params=ConsensusParams.from_json(
+                d.get("consensus_params", {})
+            ),
+            validators=[
+                GenesisValidator(
+                    pub_key=crypto.pubkey_from_type_and_bytes(
+                        gv["pub_key"]["type"], bytes.fromhex(gv["pub_key"]["value"])
+                    ),
+                    power=gv["power"],
+                    name=gv.get("name", ""),
+                )
+                for gv in d.get("validators", [])
+            ],
+            app_hash=bytes.fromhex(d.get("app_hash", "")),
+            app_state=d.get("app_state"),
+        )
+        doc.validate_and_complete()
+        return doc
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    @classmethod
+    def load(cls, path: str) -> "GenesisDoc":
+        with open(path) as f:
+            return cls.from_json(f.read())

@@ -1,0 +1,104 @@
+"""Validators (reference: types/validator.go)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..crypto import PubKey
+from ..encoding.proto import Reader, Writer
+
+
+@dataclass
+class Validator:
+    address: bytes
+    pub_key: PubKey
+    voting_power: int
+    proposer_priority: int = 0
+
+    @classmethod
+    def new(cls, pub_key: PubKey, power: int) -> "Validator":
+        return cls(pub_key.address(), pub_key, power, 0)
+
+    def validate_basic(self) -> None:
+        if self.pub_key is None:
+            raise ValueError("validator missing pubkey")
+        if self.voting_power < 0:
+            raise ValueError("negative voting power")
+        if len(self.address) != 20:
+            raise ValueError("bad address size")
+
+    def compare_proposer_priority(self, other: "Validator") -> "Validator":
+        """Higher priority wins; ties break to the lower address
+        (reference: types/validator.go CompareProposerPriority)."""
+        if self.proposer_priority > other.proposer_priority:
+            return self
+        if self.proposer_priority < other.proposer_priority:
+            return other
+        if self.address < other.address:
+            return self
+        if self.address > other.address:
+            return other
+        raise ValueError("duplicate validator address")
+
+    def bytes_for_hash(self) -> bytes:
+        """Deterministic encoding hashed into ValidatorsHash
+        (reference: types/validator.go Validator.Bytes)."""
+        w = Writer()
+        pkw = Writer()
+        pkw.string(1, self.pub_key.type_name)
+        pkw.bytes(2, self.pub_key.bytes())
+        w.message(1, pkw)
+        w.varint(2, self.voting_power)
+        return w.finish()
+
+    def copy(self) -> "Validator":
+        return Validator(
+            self.address, self.pub_key, self.voting_power, self.proposer_priority
+        )
+
+    def to_proto(self) -> Writer:
+        w = Writer()
+        w.bytes(1, self.address)
+        pkw = Writer()
+        pkw.string(1, self.pub_key.type_name)
+        pkw.bytes(2, self.pub_key.bytes())
+        w.message(2, pkw)
+        w.varint(3, self.voting_power)
+        # two's-complement for possibly-negative priority
+        w.varint(4, self.proposer_priority)
+        return w
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Validator":
+        from .. import crypto
+
+        r = Reader(data)
+        addr = b""
+        pk = None
+        power = 0
+        prio = 0
+        while not r.at_end():
+            f, wt = r.field()
+            if f == 1:
+                addr = r.bytes()
+            elif f == 2:
+                rr = Reader(r.bytes())
+                tname, kb = "", b""
+                while not rr.at_end():
+                    ff, wwt = rr.field()
+                    if ff == 1:
+                        tname = rr.string()
+                    elif ff == 2:
+                        kb = rr.bytes()
+                    else:
+                        rr.skip(wwt)
+                pk = crypto.pubkey_from_type_and_bytes(tname, kb)
+            elif f == 3:
+                power = r.varint()
+            elif f == 4:
+                prio = r.varint()
+            else:
+                r.skip(wt)
+        if pk is None:
+            raise ValueError("validator missing pubkey")
+        return cls(addr or pk.address(), pk, power, prio)

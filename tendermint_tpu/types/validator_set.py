@@ -1,0 +1,301 @@
+"""ValidatorSet: proposer rotation + batched commit verification
+(reference: types/validator_set.go).
+
+The verify_commit* family is the framework's north-star surface: where
+the reference loops `PubKey.VerifySignature` per signature
+(validator_set.go:683-705,720-762,776-824), every variant here collects
+its exact verification set first and executes it as ONE BatchVerifier
+call (TPU-wide batch, per-lane verdicts)."""
+
+from __future__ import annotations
+
+from ..crypto import merkle
+from ..crypto.batch import BatchVerifier
+from .block import BlockID
+from .validator import Validator
+
+MAX_TOTAL_VOTING_POWER = (1 << 62) // 8
+PRIORITY_WINDOW_SIZE_FACTOR = 2
+
+
+class VerificationError(Exception):
+    pass
+
+
+class ValidatorSet:
+    def __init__(self, validators: list[Validator]):
+        self._total: int | None = None
+        if validators:
+            vals = [v.copy() for v in validators]
+            vals.sort(key=lambda v: (-v.voting_power, v.address))
+            self.validators = vals
+            self.proposer: Validator | None = None
+            self._increment_proposer_priority(1)
+        else:
+            self.validators = []
+            self.proposer = None
+
+    # -- queries --
+
+    def __len__(self) -> int:
+        return len(self.validators)
+
+    def total_voting_power(self) -> int:
+        if self._total is None:
+            self._total = sum(v.voting_power for v in self.validators)
+            if self._total > MAX_TOTAL_VOTING_POWER:
+                raise ValueError("total voting power exceeds cap")
+        return self._total
+
+    def get_by_address(self, addr: bytes) -> tuple[int, Validator | None]:
+        for i, v in enumerate(self.validators):
+            if v.address == addr:
+                return i, v
+        return -1, None
+
+    def get_by_index(self, i: int) -> Validator | None:
+        if 0 <= i < len(self.validators):
+            return self.validators[i]
+        return None
+
+    def has_address(self, addr: bytes) -> bool:
+        return self.get_by_address(addr)[0] >= 0
+
+    def hash(self) -> bytes:
+        return merkle.hash_from_byte_slices(
+            [v.bytes_for_hash() for v in self.validators]
+        )
+
+    def copy(self) -> "ValidatorSet":
+        vs = ValidatorSet([])
+        vs.validators = [v.copy() for v in self.validators]
+        if self.proposer is not None:
+            i, _ = self.get_by_address(self.proposer.address)
+            vs.proposer = vs.validators[i] if i >= 0 else self.proposer.copy()
+        vs._total = self._total
+        return vs
+
+    def validate_basic(self) -> None:
+        if not self.validators:
+            raise ValueError("empty validator set")
+        for v in self.validators:
+            v.validate_basic()
+        if self.proposer is None:
+            raise ValueError("no proposer")
+
+    # -- proposer rotation (reference: validator_set.go:110-230) --
+
+    def increment_proposer_priority(self, times: int) -> None:
+        if times <= 0:
+            raise ValueError("times must be positive")
+        self._increment_proposer_priority(times)
+
+    def _increment_proposer_priority(self, times: int) -> None:
+        diff_max = PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power()
+        self._rescale_priorities(diff_max)
+        self._shift_by_avg_proposer_priority()
+        prop = None
+        for _ in range(times):
+            prop = self._single_increment()
+        self.proposer = prop
+
+    def _single_increment(self) -> Validator:
+        for v in self.validators:
+            v.proposer_priority += v.voting_power
+        mostest = self.validators[0]
+        for v in self.validators[1:]:
+            mostest = mostest.compare_proposer_priority(v)
+        mostest.proposer_priority -= self.total_voting_power()
+        return mostest
+
+    def _rescale_priorities(self, diff_max: int) -> None:
+        if diff_max <= 0 or not self.validators:
+            return
+        prios = [v.proposer_priority for v in self.validators]
+        diff = max(prios) - min(prios)
+        if diff > diff_max:
+            ratio = (diff + diff_max - 1) // diff_max
+            for v in self.validators:
+                # truncated (toward-zero) division, matching Go int64 /
+                q = abs(v.proposer_priority) // ratio
+                v.proposer_priority = q if v.proposer_priority >= 0 else -q
+
+    def _shift_by_avg_proposer_priority(self) -> None:
+        if not self.validators:
+            return
+        total = sum(v.proposer_priority for v in self.validators)
+        n = len(self.validators)
+        avg = total // n if total >= 0 else -((-total) // n)  # trunc toward 0
+        for v in self.validators:
+            v.proposer_priority -= avg
+
+    def get_proposer(self) -> Validator:
+        assert self.validators
+        if self.proposer is None:
+            self.proposer = self._find_proposer()
+        return self.proposer
+
+    def _find_proposer(self) -> Validator:
+        mostest = self.validators[0]
+        for v in self.validators[1:]:
+            mostest = mostest.compare_proposer_priority(v)
+        return mostest
+
+    # -- validator updates (reference: validator_set.go:516-646) --
+
+    def update_with_change_set(self, changes: list[Validator]) -> None:
+        """Apply ABCI validator updates: power 0 removes, new adds,
+        other powers update. New validators start at priority
+        -1.125 * new total power (reference: computeNewPriorities)."""
+        if not changes:
+            return
+        seen = set()
+        for c in changes:
+            if c.address in seen:
+                raise ValueError("duplicate address in change set")
+            seen.add(c.address)
+            if c.voting_power < 0:
+                raise ValueError("negative power update")
+
+        removals = {c.address for c in changes if c.voting_power == 0}
+        updates = {c.address: c for c in changes if c.voting_power > 0}
+
+        for addr in removals:
+            if not self.has_address(addr):
+                raise ValueError("removing unknown validator")
+        kept = [v for v in self.validators if v.address not in removals]
+
+        new_total = sum(
+            updates.get(v.address, v).voting_power for v in kept
+        ) + sum(c.voting_power for c in updates.values() if not self.has_address(c.address))
+        if new_total == 0:
+            raise ValueError("validator set would be empty")
+        if new_total > MAX_TOTAL_VOTING_POWER:
+            raise ValueError("total voting power would exceed cap")
+
+        out: list[Validator] = []
+        for v in kept:
+            if v.address in updates:
+                nv = updates.pop(v.address).copy()
+                nv.proposer_priority = v.proposer_priority
+                out.append(nv)
+            else:
+                out.append(v)
+        for c in updates.values():
+            nv = c.copy()
+            nv.proposer_priority = -(new_total + (new_total >> 3))
+            out.append(nv)
+
+        out.sort(key=lambda v: (-v.voting_power, v.address))
+        self.validators = out
+        self._total = None
+        self._shift_by_avg_proposer_priority()
+
+    # -- commit verification (batched; the hot path) --
+
+    def verify_commit(self, chain_id: str, block_id: BlockID, height: int,
+                      commit) -> None:
+        """Verify ALL non-absent signatures; tally for-block power must
+        exceed 2/3 (reference: validator_set.go:662)."""
+        self._check_commit_basics(block_id, height, commit)
+        bv = BatchVerifier()
+        lanes: list[int] = []
+        tallied = 0
+        for idx, cs in enumerate(commit.signatures):
+            if cs.is_absent():
+                continue
+            val = self.validators[idx]
+            if cs.validator_address and cs.validator_address != val.address:
+                raise VerificationError(
+                    f"wrong validator address in slot {idx}"
+                )
+            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs.signature)
+            lanes.append(idx)
+            if cs.for_block():
+                tallied += val.voting_power
+        ok, verdicts = bv.verify()
+        if not ok:
+            bad = [lanes[i] for i in range(len(lanes)) if not verdicts[i]]
+            raise VerificationError(f"invalid signature(s) at index(es) {bad}")
+        if 3 * tallied <= 2 * self.total_voting_power():
+            raise VerificationError(
+                f"insufficient voting power: {tallied} of {self.total_voting_power()}"
+            )
+
+    def verify_commit_light(self, chain_id: str, block_id: BlockID,
+                            height: int, commit) -> None:
+        """Verify only the for-block signatures needed to pass 2/3
+        (reference: validator_set.go:720) — as one batch."""
+        self._check_commit_basics(block_id, height, commit)
+        bv = BatchVerifier()
+        lanes: list[int] = []
+        tallied = 0
+        need = 2 * self.total_voting_power()
+        for idx, cs in enumerate(commit.signatures):
+            if not cs.for_block():
+                continue
+            val = self.validators[idx]
+            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs.signature)
+            lanes.append(idx)
+            tallied += val.voting_power
+            if 3 * tallied > need:
+                break
+        if 3 * tallied <= need:
+            raise VerificationError(
+                f"insufficient voting power: {tallied} of {self.total_voting_power()}"
+            )
+        ok, verdicts = bv.verify()
+        if not ok:
+            bad = [lanes[i] for i in range(len(lanes)) if not verdicts[i]]
+            raise VerificationError(f"invalid signature(s) at index(es) {bad}")
+
+    def verify_commit_light_trusting(self, chain_id: str, commit,
+                                     trust_num: int, trust_den: int) -> None:
+        """Trust-fraction variant for light-client skipping verification
+        (reference: validator_set.go:776). Validators are matched by
+        ADDRESS (the commit came from a possibly newer set)."""
+        if trust_den <= 0 or trust_num <= 0 or trust_num > trust_den:
+            raise ValueError("invalid trust level")
+        bv = BatchVerifier()
+        lanes: list[int] = []
+        tallied = 0
+        need = self.total_voting_power() * trust_num
+        seen: set[int] = set()
+        for idx, cs in enumerate(commit.signatures):
+            if not cs.for_block():
+                continue
+            vi, val = self.get_by_address(cs.validator_address)
+            if vi < 0:
+                continue
+            if vi in seen:
+                raise VerificationError("double vote from same validator")
+            seen.add(vi)
+            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs.signature)
+            lanes.append(idx)
+            tallied += val.voting_power
+            if tallied * trust_den > need:
+                break
+        if tallied * trust_den <= need:
+            raise VerificationError(
+                f"insufficient trusted power: {tallied}"
+            )
+        ok, verdicts = bv.verify()
+        if not ok:
+            bad = [lanes[i] for i in range(len(lanes)) if not verdicts[i]]
+            raise VerificationError(f"invalid signature(s) at index(es) {bad}")
+
+    def _check_commit_basics(self, block_id: BlockID, height: int, commit) -> None:
+        if commit is None:
+            raise VerificationError("nil commit")
+        if len(self.validators) != len(commit.signatures):
+            raise VerificationError(
+                f"commit has {len(commit.signatures)} sigs, valset has "
+                f"{len(self.validators)}"
+            )
+        if height != commit.height:
+            raise VerificationError(f"commit height {commit.height} != {height}")
+        if commit.block_id != block_id:
+            raise VerificationError("commit is for a different block")
+
+    def __repr__(self) -> str:
+        return f"ValidatorSet(n={len(self.validators)}, power={self.total_voting_power()})"

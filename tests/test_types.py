@@ -1,0 +1,209 @@
+"""Core types: sign bytes, hashing, wire round trips, part sets."""
+
+import time
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.types import (
+    Block, BlockID, Commit, CommitSig, Data, Header, PartSetHeader,
+    Proposal, Vote, VoteType,
+)
+from tendermint_tpu.types.block import BlockIDFlag, PartSet
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence, EvidenceData
+
+
+def _block_id(n=1):
+    return BlockID(bytes([n]) * 32, PartSetHeader(1, bytes([n + 1]) * 32))
+
+
+def _vote(priv, height=5, round_=0, block_id=None, idx=0):
+    v = Vote(
+        type=VoteType.PRECOMMIT,
+        height=height,
+        round=round_,
+        block_id=block_id,
+        timestamp=time.time_ns(),
+        validator_address=priv.pub_key().address(),
+        validator_index=idx,
+    )
+    return v
+
+
+class TestVote:
+    def test_sign_verify_roundtrip(self):
+        priv = ed25519.Ed25519PrivKey.from_secret(b"v1")
+        v = _vote(priv, block_id=_block_id())
+        v.signature = priv.sign(v.sign_bytes("test-chain"))
+        assert v.verify("test-chain", priv.pub_key())
+        assert not v.verify("other-chain", priv.pub_key())
+        other = ed25519.Ed25519PrivKey.from_secret(b"v2")
+        assert not v.verify("test-chain", other.pub_key())
+
+    def test_sign_bytes_deterministic_and_distinct(self):
+        priv = ed25519.Ed25519PrivKey.from_secret(b"v1")
+        v = _vote(priv, block_id=_block_id())
+        assert v.sign_bytes("c") == v.sign_bytes("c")
+        v2 = _vote(priv, block_id=_block_id())
+        v2.height += 1
+        assert v.sign_bytes("c") != v2.sign_bytes("c")
+        v3 = _vote(priv, block_id=None)
+        v3.timestamp = v.timestamp
+        assert v.sign_bytes("c") != v3.sign_bytes("c")
+
+    def test_wire_roundtrip(self):
+        priv = ed25519.Ed25519PrivKey.from_secret(b"v1")
+        v = _vote(priv, block_id=_block_id())
+        v.signature = b"s" * 64
+        rt = Vote.from_bytes(v.to_bytes())
+        assert rt == v
+        vnil = _vote(priv, block_id=None)
+        vnil.signature = b"s" * 64
+        rt2 = Vote.from_bytes(vnil.to_bytes())
+        assert rt2.is_nil()
+
+    def test_validate_basic(self):
+        priv = ed25519.Ed25519PrivKey.from_secret(b"v1")
+        v = _vote(priv, block_id=_block_id())
+        with pytest.raises(ValueError, match="missing signature"):
+            v.validate_basic()
+        v.signature = b"x" * 64
+        v.validate_basic()
+        v.height = 0
+        with pytest.raises(ValueError):
+            v.validate_basic()
+
+
+class TestProposal:
+    def test_sign_and_wire(self):
+        priv = ed25519.Ed25519PrivKey.from_secret(b"p")
+        p = Proposal(height=3, round=1, pol_round=-1, block_id=_block_id(),
+                     timestamp=time.time_ns())
+        p.signature = priv.sign(p.sign_bytes("c"))
+        assert priv.pub_key().verify_signature(p.sign_bytes("c"), p.signature)
+        rt = Proposal.from_bytes(p.to_bytes())
+        assert rt == p
+        p.validate_basic()
+
+    def test_pol_round_bounds(self):
+        p = Proposal(height=3, round=1, pol_round=1, block_id=_block_id(),
+                     signature=b"x")
+        with pytest.raises(ValueError, match="POL"):
+            p.validate_basic()
+
+
+def _header(height=3):
+    return Header(
+        version_block=11, version_app=1, chain_id="test-chain", height=height,
+        time=time.time_ns(), last_block_id=_block_id(),
+        last_commit_hash=b"\x01" * 32, data_hash=b"\x02" * 32,
+        validators_hash=b"\x03" * 32, next_validators_hash=b"\x04" * 32,
+        consensus_hash=b"\x05" * 32, app_hash=b"\x06" * 8,
+        last_results_hash=b"\x07" * 32, evidence_hash=b"\x08" * 32,
+        proposer_address=b"\x09" * 20,
+    )
+
+
+class TestHeaderAndBlock:
+    def test_header_hash_deterministic(self):
+        h = _header()
+        h2 = _header()
+        h2.time = h.time
+        assert h.hash() == h2.hash()
+        h3 = _header()
+        h3.time = h.time
+        h3.app_hash = b"\xff" * 8
+        assert h.hash() != h3.hash()
+
+    def test_header_wire_roundtrip(self):
+        h = _header()
+        rt = Header.from_bytes(h.to_proto().finish())
+        assert rt == h
+        assert rt.hash() == h.hash()
+
+    def test_block_roundtrip_and_partset(self):
+        commit = Commit(2, 0, _block_id(), [
+            CommitSig(BlockIDFlag.COMMIT, b"\x01" * 20, time.time_ns(), b"s" * 64),
+        ])
+        data = Data(txs=[b"tx1", b"tx2" * 1000])
+        h = _header()
+        h.data_hash = data.hash()
+        h.last_commit_hash = commit.hash()
+        h.evidence_hash = EvidenceData().hash()
+        b = Block(h, data, EvidenceData(), commit)
+        b.validate_basic()
+        rt = Block.from_bytes(b.to_bytes())
+        assert rt.hash() == b.hash()
+        assert rt.data.txs == b.data.txs
+        assert rt.last_commit.hash() == commit.hash()
+
+        ps = b.make_part_set(512)
+        assert ps.is_complete()
+        assert ps.assemble() == b.to_bytes()
+        # rebuild from parts one by one
+        ps2 = PartSet(ps.total, ps.hash)
+        for i in range(ps.total):
+            assert ps2.add_part(ps.get_part(i))
+        assert ps2.is_complete()
+        assert Block.from_bytes(ps2.assemble()).hash() == b.hash()
+
+    def test_partset_rejects_bad_proof(self):
+        b = Block(_header(), Data(txs=[b"t" * 2000]), EvidenceData(), None)
+        ps = b.make_part_set(256)
+        ps2 = PartSet(ps.total, ps.hash)
+        part = ps.get_part(0)
+        import copy
+
+        bad = copy.deepcopy(part)
+        bad.bytes_ = b"evil" + bad.bytes_[4:]
+        with pytest.raises(ValueError, match="invalid part proof"):
+            ps2.add_part(bad)
+
+
+class TestCommit:
+    def test_commit_wire_and_hash(self):
+        c = Commit(7, 2, _block_id(), [
+            CommitSig.absent(),
+            CommitSig(BlockIDFlag.COMMIT, b"\x02" * 20, 12345, b"a" * 64),
+            CommitSig(BlockIDFlag.NIL, b"\x03" * 20, 999, b"b" * 64),
+        ])
+        rt = Commit.from_bytes(c.to_bytes())
+        assert rt.height == 7 and rt.round == 2
+        assert rt.hash() == c.hash()
+        assert rt.signatures[0].is_absent()
+        assert rt.signatures[1].for_block()
+        assert not rt.signatures[2].for_block()
+
+    def test_vote_sign_bytes_matches_vote(self):
+        """Commit.vote_sign_bytes must reproduce the original vote's
+        sign bytes (consensus-critical)."""
+        priv = ed25519.Ed25519PrivKey.from_secret(b"c")
+        bid = _block_id()
+        v = _vote(priv, height=7, block_id=bid)
+        c = Commit(7, 0, bid, [
+            CommitSig(BlockIDFlag.COMMIT, v.validator_address, v.timestamp, b"s"),
+        ])
+        assert c.vote_sign_bytes("chain", 0) == v.sign_bytes("chain")
+        # nil-vote slot reproduces a nil vote's bytes
+        vnil = _vote(priv, height=7, block_id=None)
+        c2 = Commit(7, 0, bid, [
+            CommitSig(BlockIDFlag.NIL, v.validator_address, vnil.timestamp, b"s"),
+        ])
+        assert c2.vote_sign_bytes("chain", 0) == vnil.sign_bytes("chain")
+
+
+class TestEvidence:
+    def test_duplicate_vote_evidence_roundtrip(self):
+        priv = ed25519.Ed25519PrivKey.from_secret(b"e")
+        v1 = _vote(priv, block_id=_block_id(1))
+        v1.signature = b"x" * 64
+        v2 = _vote(priv, block_id=_block_id(3))
+        v2.signature = b"y" * 64
+        ev = DuplicateVoteEvidence(v1, v2, 10, 3, 123)
+        ev.validate_basic()
+        from tendermint_tpu.types.evidence import evidence_from_bytes
+
+        rt = evidence_from_bytes(ev.to_bytes())
+        assert isinstance(rt, DuplicateVoteEvidence)
+        assert rt.hash() == ev.hash()
+        assert rt.vote_a == v1 and rt.vote_b == v2

@@ -1,0 +1,193 @@
+"""ValidatorSet: proposer rotation, updates, batched commit verification."""
+
+import time
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.types import (
+    BlockID, Commit, CommitSig, PartSetHeader, Validator, ValidatorSet,
+    Vote, VoteType,
+)
+from tendermint_tpu.types.block import BlockIDFlag
+from tendermint_tpu.types.validator_set import VerificationError
+
+CHAIN = "test-chain"
+
+
+def make_valset(n, power=10):
+    privs = [ed25519.Ed25519PrivKey.from_secret(b"val%d" % i) for i in range(n)]
+    vals = [Validator.new(p.pub_key(), power) for p in privs]
+    vs = ValidatorSet(vals)
+    by_addr = {p.pub_key().address(): p for p in privs}
+    ordered = [by_addr[v.address] for v in vs.validators]
+    return vs, ordered
+
+
+def make_commit(vs, privs, height=5, round_=0, block_id=None, nil_idxs=(),
+                absent_idxs=(), bad_sig_idxs=()):
+    block_id = block_id or BlockID(b"\xaa" * 32, PartSetHeader(1, b"\xbb" * 32))
+    sigs = []
+    for i, priv in enumerate(privs):
+        if i in absent_idxs:
+            sigs.append(CommitSig.absent())
+            continue
+        is_nil = i in nil_idxs
+        v = Vote(
+            type=VoteType.PRECOMMIT, height=height, round=round_,
+            block_id=None if is_nil else block_id,
+            timestamp=1700000000_000000000 + i,
+            validator_address=priv.pub_key().address(), validator_index=i,
+        )
+        v.signature = priv.sign(v.sign_bytes(CHAIN))
+        if i in bad_sig_idxs:
+            v.signature = bytes(64)
+        sigs.append(CommitSig(
+            BlockIDFlag.NIL if is_nil else BlockIDFlag.COMMIT,
+            v.validator_address, v.timestamp, v.signature,
+        ))
+    return Commit(height, round_, block_id, sigs), block_id
+
+
+class TestProposerRotation:
+    def test_round_robin_equal_power(self):
+        vs, _ = make_valset(4)
+        seen = []
+        for _ in range(8):
+            seen.append(vs.get_proposer().address)
+            vs.increment_proposer_priority(1)
+        # each validator proposes exactly twice over two full cycles
+        assert sorted(seen.count(a) for a in set(seen)) == [2, 2, 2, 2]
+
+    def test_weighted_rotation(self):
+        p1 = ed25519.Ed25519PrivKey.from_secret(b"a")
+        p2 = ed25519.Ed25519PrivKey.from_secret(b"b")
+        vs = ValidatorSet([
+            Validator.new(p1.pub_key(), 3),
+            Validator.new(p2.pub_key(), 1),
+        ])
+        count = {p1.pub_key().address(): 0, p2.pub_key().address(): 0}
+        for _ in range(8):
+            count[vs.get_proposer().address] += 1
+            vs.increment_proposer_priority(1)
+        assert count[p1.pub_key().address()] == 6
+        assert count[p2.pub_key().address()] == 2
+
+    def test_deterministic_across_copies(self):
+        vs1, _ = make_valset(7, power=5)
+        vs2, _ = make_valset(7, power=5)
+        for _ in range(50):
+            assert vs1.get_proposer().address == vs2.get_proposer().address
+            vs1.increment_proposer_priority(1)
+            vs2.increment_proposer_priority(1)
+
+
+class TestUpdates:
+    def test_add_update_remove(self):
+        vs, privs = make_valset(3)
+        new_priv = ed25519.Ed25519PrivKey.from_secret(b"new")
+        vs.update_with_change_set([Validator.new(new_priv.pub_key(), 7)])
+        assert len(vs) == 4
+        assert vs.total_voting_power() == 37
+        # update power
+        vs.update_with_change_set([Validator.new(privs[0].pub_key(), 1)])
+        _, v = vs.get_by_address(privs[0].pub_key().address())
+        assert v.voting_power == 1
+        # remove
+        vs.update_with_change_set([Validator.new(new_priv.pub_key(), 0)])
+        assert len(vs) == 3
+        assert not vs.has_address(new_priv.pub_key().address())
+
+    def test_remove_unknown_fails(self):
+        vs, _ = make_valset(3)
+        ghost = ed25519.Ed25519PrivKey.from_secret(b"ghost")
+        with pytest.raises(ValueError, match="unknown"):
+            vs.update_with_change_set([Validator.new(ghost.pub_key(), 0)])
+
+    def test_hash_changes_with_set(self):
+        vs, privs = make_valset(3)
+        h1 = vs.hash()
+        vs.update_with_change_set([Validator.new(privs[0].pub_key(), 99)])
+        assert vs.hash() != h1
+
+
+class TestVerifyCommit:
+    def test_all_valid(self):
+        vs, privs = make_valset(10)
+        commit, bid = make_commit(vs, privs)
+        vs.verify_commit(CHAIN, bid, 5, commit)
+        vs.verify_commit_light(CHAIN, bid, 5, commit)
+
+    def test_bad_sig_detected_with_index(self):
+        vs, privs = make_valset(10)
+        commit, bid = make_commit(vs, privs, bad_sig_idxs=(3,))
+        with pytest.raises(VerificationError, match=r"\[3\]"):
+            vs.verify_commit(CHAIN, bid, 5, commit)
+
+    def test_insufficient_power(self):
+        vs, privs = make_valset(9)
+        # 3 absent + 3 nil = only 3/9 for block
+        commit, bid = make_commit(
+            vs, privs, nil_idxs=(0, 1, 2), absent_idxs=(3, 4, 5)
+        )
+        with pytest.raises(VerificationError, match="insufficient"):
+            vs.verify_commit(CHAIN, bid, 5, commit)
+
+    def test_exactly_two_thirds_fails_needs_more(self):
+        vs, privs = make_valset(3)
+        commit, bid = make_commit(vs, privs, absent_idxs=(2,))
+        # 2 of 3 = exactly 2/3, needs strictly greater
+        with pytest.raises(VerificationError, match="insufficient"):
+            vs.verify_commit(CHAIN, bid, 5, commit)
+
+    def test_nil_votes_verified_but_not_tallied(self):
+        vs, privs = make_valset(4)
+        commit, bid = make_commit(vs, privs, nil_idxs=(3,))
+        vs.verify_commit(CHAIN, bid, 5, commit)  # 3/4 > 2/3 ok
+        # but a bad nil sig still fails full verification
+        commit2, bid2 = make_commit(vs, privs, nil_idxs=(3,), bad_sig_idxs=(3,))
+        with pytest.raises(VerificationError, match=r"\[3\]"):
+            vs.verify_commit(CHAIN, bid2, 5, commit2)
+        # light verification skips nil sigs entirely
+        vs.verify_commit_light(CHAIN, bid2, 5, commit2)
+
+    def test_light_stops_at_threshold(self):
+        vs, privs = make_valset(10)
+        # corrupt a sig BEYOND the 2/3 prefix: light must not check it
+        commit, bid = make_commit(vs, privs, bad_sig_idxs=(9,))
+        vs.verify_commit_light(CHAIN, bid, 5, commit)
+        with pytest.raises(VerificationError):
+            vs.verify_commit(CHAIN, bid, 5, commit)
+
+    def test_wrong_height_or_block(self):
+        vs, privs = make_valset(4)
+        commit, bid = make_commit(vs, privs)
+        with pytest.raises(VerificationError, match="height"):
+            vs.verify_commit(CHAIN, bid, 6, commit)
+        other = BlockID(b"\xcc" * 32, PartSetHeader(1, b"\xdd" * 32))
+        with pytest.raises(VerificationError, match="different block"):
+            vs.verify_commit(CHAIN, other, 5, commit)
+
+    def test_light_trusting(self):
+        vs, privs = make_valset(6)
+        commit, bid = make_commit(vs, privs)
+        # same valset, 1/3 trust: needs > 20 power of 60
+        vs.verify_commit_light_trusting(CHAIN, commit, 1, 3)
+        # a subset valset (simulate older set): only 2 validators known
+        old = ValidatorSet([
+            Validator.new(p.pub_key(), 10) for p in privs[:2]
+        ])
+        old.verify_commit_light_trusting(CHAIN, commit, 1, 3)
+
+    def test_light_trusting_insufficient(self):
+        vs, privs = make_valset(6)
+        commit, bid = make_commit(vs, privs, absent_idxs=(0, 1, 2, 3))
+        with pytest.raises(VerificationError, match="insufficient"):
+            vs.verify_commit_light_trusting(CHAIN, commit, 2, 3)
+
+    def test_large_commit_batch(self):
+        """150-validator commit — the light-client baseline config —
+        runs through one BatchVerifier call."""
+        vs, privs = make_valset(150, power=1)
+        commit, bid = make_commit(vs, privs)
+        vs.verify_commit(CHAIN, bid, 5, commit)

@@ -1,0 +1,151 @@
+"""VoteSet semantics: maj23, duplicates, conflicts, commit construction."""
+
+import pytest
+
+from tendermint_tpu.types import BlockID, PartSetHeader, Vote, VoteType
+from tendermint_tpu.types.vote_set import (
+    ConflictingVoteError, VoteSet, VoteSetError,
+)
+from tests.test_validator_set import make_valset
+
+CHAIN = "test-chain"
+BID = BlockID(b"\xaa" * 32, PartSetHeader(1, b"\xbb" * 32))
+BID2 = BlockID(b"\xcc" * 32, PartSetHeader(1, b"\xdd" * 32))
+
+
+def signed_vote(priv, idx, block_id=BID, height=1, round_=0,
+                type_=VoteType.PREVOTE, ts=1700000000_000000000):
+    v = Vote(
+        type=type_, height=height, round=round_, block_id=block_id,
+        timestamp=ts, validator_address=priv.pub_key().address(),
+        validator_index=idx,
+    )
+    v.signature = priv.sign(v.sign_bytes(CHAIN))
+    return v
+
+
+def test_maj23_progression():
+    vs, privs = make_valset(4)
+    voteset = VoteSet(CHAIN, 1, 0, VoteType.PREVOTE, vs)
+    for i in range(2):
+        assert voteset.add_vote(signed_vote(privs[i], i))
+        assert not voteset.has_two_thirds_majority()
+    assert voteset.add_vote(signed_vote(privs[2], 2))
+    assert voteset.has_two_thirds_majority()
+    assert voteset.two_thirds_majority() == (BID, True)
+
+
+def test_duplicate_vote_is_noop():
+    vs, privs = make_valset(4)
+    voteset = VoteSet(CHAIN, 1, 0, VoteType.PREVOTE, vs)
+    v = signed_vote(privs[0], 0)
+    assert voteset.add_vote(v)
+    assert not voteset.add_vote(v)
+
+
+def test_invalid_signature_rejected():
+    vs, privs = make_valset(4)
+    voteset = VoteSet(CHAIN, 1, 0, VoteType.PREVOTE, vs)
+    v = signed_vote(privs[0], 0)
+    v.signature = bytes(64)
+    with pytest.raises(VoteSetError, match="invalid signature"):
+        voteset.add_vote(v)
+
+
+def test_wrong_index_address_mismatch():
+    vs, privs = make_valset(4)
+    voteset = VoteSet(CHAIN, 1, 0, VoteType.PREVOTE, vs)
+    v = signed_vote(privs[0], 1)  # wrong slot
+    with pytest.raises(VoteSetError, match="address mismatch"):
+        voteset.add_vote(v)
+
+
+def test_conflicting_vote_raises_with_both_votes():
+    vs, privs = make_valset(4)
+    voteset = VoteSet(CHAIN, 1, 0, VoteType.PREVOTE, vs)
+    v1 = signed_vote(privs[0], 0, BID)
+    v2 = signed_vote(privs[0], 0, BID2)
+    assert voteset.add_vote(v1)
+    with pytest.raises(ConflictingVoteError) as ei:
+        voteset.add_vote(v2)
+    assert ei.value.existing == v1
+    assert ei.value.new == v2
+    # original vote still counted
+    assert voteset.get_by_index(0) == v1
+
+
+def test_peer_maj23_allows_conflicting_tally():
+    """After a peer claims +2/3 for BID2, a conflicting vote for BID2 is
+    tracked (still raises for evidence) and can flip maj23."""
+    vs, privs = make_valset(4)
+    voteset = VoteSet(CHAIN, 1, 0, VoteType.PREVOTE, vs)
+    for i in range(3):
+        voteset.add_vote(signed_vote(privs[i], i, BID))
+    assert voteset.two_thirds_majority() == (BID, True)
+    voteset.set_peer_maj23("peer1", BID2)
+    with pytest.raises(ConflictingVoteError):
+        voteset.add_vote(signed_vote(privs[0], 0, BID2))
+    # the conflicting vote was tallied under BID2
+    ba = voteset.bit_array_by_block_id(BID2)
+    assert ba is not None and ba.get(0)
+
+
+def test_nil_votes_and_two_thirds_any():
+    vs, privs = make_valset(3)
+    voteset = VoteSet(CHAIN, 1, 0, VoteType.PRECOMMIT, vs)
+    for i in range(3):
+        voteset.add_vote(signed_vote(privs[i], i, None, type_=VoteType.PRECOMMIT))
+    assert voteset.has_two_thirds_any()
+    assert voteset.has_all()
+    # majority FOR NIL is a real majority, distinct from no-majority
+    bid, ok = voteset.two_thirds_majority()
+    assert ok and bid is None
+
+
+def test_make_commit():
+    vs, privs = make_valset(4)
+    voteset = VoteSet(CHAIN, 2, 1, VoteType.PRECOMMIT, vs)
+    for i in range(3):
+        voteset.add_vote(
+            signed_vote(privs[i], i, BID, height=2, round_=1,
+                        type_=VoteType.PRECOMMIT)
+        )
+    commit = voteset.make_commit()
+    assert commit.height == 2 and commit.round == 1
+    assert commit.block_id == BID
+    assert commit.signatures[3].is_absent()
+    assert sum(1 for s in commit.signatures if s.for_block()) == 3
+    # the built commit passes full verification
+    vs.verify_commit(CHAIN, BID, 2, commit)
+
+
+def test_make_commit_requires_block_majority():
+    vs, privs = make_valset(4)
+    voteset = VoteSet(CHAIN, 1, 0, VoteType.PRECOMMIT, vs)
+    for i in range(3):
+        voteset.add_vote(
+            signed_vote(privs[i], i, None, type_=VoteType.PRECOMMIT)
+        )
+    with pytest.raises(VoteSetError, match="majority"):
+        voteset.make_commit()
+
+
+def test_wrong_height_round_type():
+    vs, privs = make_valset(4)
+    voteset = VoteSet(CHAIN, 1, 0, VoteType.PREVOTE, vs)
+    with pytest.raises(VoteSetError, match="expected"):
+        voteset.add_vote(signed_vote(privs[0], 0, height=2))
+    with pytest.raises(VoteSetError, match="expected"):
+        voteset.add_vote(signed_vote(privs[0], 0, round_=1))
+    with pytest.raises(VoteSetError, match="expected"):
+        voteset.add_vote(signed_vote(privs[0], 0, type_=VoteType.PRECOMMIT))
+
+
+def test_pre_verified_path():
+    """verify=False trusts the caller (the TPU micro-batch scheduler)."""
+    vs, privs = make_valset(4)
+    voteset = VoteSet(CHAIN, 1, 0, VoteType.PREVOTE, vs)
+    v = signed_vote(privs[0], 0)
+    v.signature = b"z" * 64  # would fail verification
+    assert voteset.add_vote(v, verify=False)
+    assert voteset.get_by_index(0) == v
