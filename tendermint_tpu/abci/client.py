@@ -1,0 +1,207 @@
+"""ABCI clients (reference: abci/client/).
+
+LocalClient wraps an in-process Application behind a per-connection
+asyncio.Lock (reference: local_client.go's mutex). SocketClient speaks
+the varint-length-framed message protocol to an out-of-process app and
+pipelines requests: callers get futures resolved in strict FIFO order
+by the response reader (reference: socket_client.go:36,128,167 —
+same pipelining model, asyncio-native instead of goroutines+reqQueue).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..encoding.proto import encode_varint
+from ..libs.service import Service
+from . import types as t
+
+
+class ABCIClientError(Exception):
+    pass
+
+
+class Client(Service):
+    """Interface: deliver(req) -> response; flush() drains the pipe."""
+
+    async def deliver(self, req):
+        raise NotImplementedError
+
+    async def flush(self) -> None:
+        pass
+
+    # typed sugar
+    async def echo(self, msg: str) -> t.ResponseEcho:
+        return await self.deliver(t.RequestEcho(msg))
+
+    async def info(self, req: t.RequestInfo) -> t.ResponseInfo:
+        return await self.deliver(req)
+
+    async def query(self, req: t.RequestQuery) -> t.ResponseQuery:
+        return await self.deliver(req)
+
+    async def check_tx(self, req: t.RequestCheckTx) -> t.ResponseCheckTx:
+        return await self.deliver(req)
+
+    async def init_chain(self, req: t.RequestInitChain) -> t.ResponseInitChain:
+        return await self.deliver(req)
+
+    async def begin_block(self, req: t.RequestBeginBlock) -> t.ResponseBeginBlock:
+        return await self.deliver(req)
+
+    async def deliver_tx(self, req: t.RequestDeliverTx) -> t.ResponseDeliverTx:
+        return await self.deliver(req)
+
+    async def end_block(self, req: t.RequestEndBlock) -> t.ResponseEndBlock:
+        return await self.deliver(req)
+
+    async def commit(self) -> t.ResponseCommit:
+        return await self.deliver(t.RequestCommit())
+
+    async def list_snapshots(self) -> t.ResponseListSnapshots:
+        return await self.deliver(t.RequestListSnapshots())
+
+    async def offer_snapshot(
+        self, req: t.RequestOfferSnapshot
+    ) -> t.ResponseOfferSnapshot:
+        return await self.deliver(req)
+
+    async def load_snapshot_chunk(
+        self, req: t.RequestLoadSnapshotChunk
+    ) -> t.ResponseLoadSnapshotChunk:
+        return await self.deliver(req)
+
+    async def apply_snapshot_chunk(
+        self, req: t.RequestApplySnapshotChunk
+    ) -> t.ResponseApplySnapshotChunk:
+        return await self.deliver(req)
+
+    def submit(self, req) -> asyncio.Task:
+        """Fire a request without awaiting — the async-pipelined
+        DeliverTx path (reference: socket_client.go DeliverTxAsync)."""
+        return asyncio.get_event_loop().create_task(self.deliver(req))
+
+
+class LocalClient(Client):
+    """In-process client; one lock per connection serializes app calls
+    (the app itself may be shared by several LocalClients, matching the
+    reference where one mutex guards the app across connections)."""
+
+    def __init__(self, app: t.Application, lock: asyncio.Lock | None = None):
+        super().__init__(name="abci.LocalClient")
+        self.app = app
+        self._lock = lock or asyncio.Lock()
+
+    async def deliver(self, req):
+        if isinstance(req, t.RequestEcho):
+            return t.ResponseEcho(req.message)
+        if isinstance(req, t.RequestFlush):
+            return t.ResponseFlush()
+        method = t.HANDLERS[type(req)]
+        async with self._lock:
+            return getattr(self.app, method)(req)
+
+
+# --- socket framing: varint length prefix + JSON message ---------------------
+
+
+def write_frame(writer: asyncio.StreamWriter, msg) -> None:
+    data = t.encode_msg(msg)
+    writer.write(encode_varint(len(data)) + data)
+
+
+async def read_frame(reader: asyncio.StreamReader):
+    # read varint byte-by-byte, then the payload
+    ln = shift = 0
+    while True:
+        b = await reader.readexactly(1)
+        ln |= (b[0] & 0x7F) << shift
+        if not b[0] & 0x80:
+            break
+        shift += 7
+        if shift > 35:
+            raise ABCIClientError("frame length varint too long")
+    if ln > 64 << 20:
+        raise ABCIClientError("frame too large")
+    return t.decode_msg(await reader.readexactly(ln))
+
+
+class SocketClient(Client):
+    """Pipelined socket client. Responses arrive strictly in request
+    order, so a FIFO of futures pairs them back up."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 26658,
+                 unix_path: str | None = None):
+        super().__init__(name="abci.SocketClient")
+        self.host, self.port, self.unix_path = host, port, unix_path
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._pending: asyncio.Queue[asyncio.Future] = asyncio.Queue()
+        self._conn_err: Exception | None = None
+
+    async def on_start(self) -> None:
+        if self.unix_path:
+            self._reader, self._writer = await asyncio.open_unix_connection(
+                self.unix_path
+            )
+        else:
+            self._reader, self._writer = await asyncio.open_connection(
+                self.host, self.port
+            )
+        self.spawn(self._recv_loop(), name="abci-recv")
+
+    async def on_stop(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+
+    async def _recv_loop(self) -> None:
+        assert self._reader is not None
+        try:
+            while True:
+                resp = await read_frame(self._reader)
+                fut = await self._pending.get()
+                if isinstance(resp, t.ResponseException):
+                    fut.set_exception(ABCIClientError(resp.error))
+                elif not fut.done():
+                    fut.set_result(resp)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
+            self._conn_err = e
+            while not self._pending.empty():
+                fut = self._pending.get_nowait()
+                if not fut.done():
+                    fut.set_exception(ABCIClientError(f"connection lost: {e}"))
+
+    async def deliver(self, req):
+        if self._conn_err is not None:
+            raise ABCIClientError(f"connection lost: {self._conn_err}")
+        assert self._writer is not None, "client not started"
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        await self._pending.put(fut)
+        write_frame(self._writer, req)
+        await self._writer.drain()
+        return await fut
+
+    async def flush(self) -> None:
+        await self.deliver(t.RequestFlush())
+
+
+class ClientCreator:
+    """Factory handed to proxy.AppConns: local app or remote addr
+    (reference: proxy/client.go NewLocalClientCreator/NewRemoteClientCreator)."""
+
+    def __init__(self, app: t.Application | None = None,
+                 addr: tuple[str, int] | None = None,
+                 unix_path: str | None = None,
+                 shared_lock: bool = True):
+        self.app = app
+        self.addr = addr
+        self.unix_path = unix_path
+        self._lock = asyncio.Lock() if (app is not None and shared_lock) else None
+
+    def new_client(self) -> Client:
+        if self.app is not None:
+            return LocalClient(self.app, self._lock)
+        if self.unix_path is not None:
+            return SocketClient(unix_path=self.unix_path)
+        assert self.addr is not None
+        return SocketClient(self.addr[0], self.addr[1])

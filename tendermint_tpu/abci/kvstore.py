@@ -1,0 +1,217 @@
+"""Example apps (reference: abci/example/kvstore/kvstore.go:66,
+persistent_kvstore.go:27,108).
+
+KVStoreApp: in-memory "key=value" store; app hash = 8-byte big-endian
+tx count (matching the reference's size-as-apphash trick).
+PersistentKVStoreApp adds durable state, height tracking for crash
+replay (the Handshaker relies on Info.last_block_height), validator
+updates via "val:<pubkey-hex>!<power>" txs, and statesync snapshots.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+from ..libs.db import DB, MemDB
+from . import types as t
+
+VALIDATOR_TX_PREFIX = b"val:"
+_STATE_KEY = b"__appstate__"
+
+
+def encode_validator_tx(pub_key_hex: str, power: int) -> bytes:
+    return VALIDATOR_TX_PREFIX + f"{pub_key_hex}!{power}".encode()
+
+
+class KVStoreApp(t.Application):
+    def __init__(self):
+        self.db: DB = MemDB()
+        self.size = 0
+        self.height = 0
+        self.app_hash = b""
+
+    def info(self, req: t.RequestInfo) -> t.ResponseInfo:
+        return t.ResponseInfo(
+            data=json.dumps({"size": self.size}),
+            version="kvstore/1",
+            app_version=1,
+            last_block_height=self.height,
+            last_block_app_hash=self.app_hash,
+        )
+
+    def check_tx(self, req: t.RequestCheckTx) -> t.ResponseCheckTx:
+        return t.ResponseCheckTx(code=t.CODE_TYPE_OK, gas_wanted=1)
+
+    def deliver_tx(self, req: t.RequestDeliverTx) -> t.ResponseDeliverTx:
+        key, _, value = req.tx.partition(b"=")
+        if not value:
+            key = value = req.tx
+        self.db.set(b"kv:" + key, value)
+        self.size += 1
+        return t.ResponseDeliverTx(
+            code=t.CODE_TYPE_OK,
+            events=[{
+                "type": "app",
+                "attributes": [
+                    {"key": "creator", "value": "kvstore"},
+                    {"key": "key", "value": key.decode(errors="replace")},
+                ],
+            }],
+        )
+
+    def commit(self, req: t.RequestCommit) -> t.ResponseCommit:
+        self.app_hash = struct.pack(">Q", self.size)
+        self.height += 1
+        return t.ResponseCommit(data=self.app_hash)
+
+    def query(self, req: t.RequestQuery) -> t.ResponseQuery:
+        v = self.db.get(b"kv:" + req.data)
+        return t.ResponseQuery(
+            key=req.data,
+            value=v or b"",
+            log="exists" if v is not None else "does not exist",
+            height=self.height,
+        )
+
+
+class PersistentKVStoreApp(KVStoreApp):
+    """Adds persistence + validator-update txs + snapshots."""
+
+    SNAPSHOT_CHUNK_SIZE = 1 << 16
+
+    def __init__(self, db: DB | None = None):
+        super().__init__()
+        self.db = db or MemDB()
+        self.val_updates: list[t.ValidatorUpdate] = []
+        self.validators: dict[str, int] = {}  # pubkey hex -> power
+        self.retain_blocks = 0
+        st = self.db.get(_STATE_KEY)
+        if st is not None:
+            d = json.loads(st)
+            self.size = d["size"]
+            self.height = d["height"]
+            self.app_hash = bytes.fromhex(d["app_hash"])
+            self.validators = d.get("validators", {})
+
+    def init_chain(self, req: t.RequestInitChain) -> t.ResponseInitChain:
+        for vu in req.validators:
+            self._update_validator(vu)
+        return t.ResponseInitChain()
+
+    def begin_block(self, req: t.RequestBeginBlock) -> t.ResponseBeginBlock:
+        self.val_updates = []
+        return t.ResponseBeginBlock()
+
+    def deliver_tx(self, req: t.RequestDeliverTx) -> t.ResponseDeliverTx:
+        if req.tx.startswith(VALIDATOR_TX_PREFIX):
+            return self._deliver_validator_tx(req.tx)
+        return super().deliver_tx(req)
+
+    def _deliver_validator_tx(self, tx: bytes) -> t.ResponseDeliverTx:
+        body = tx[len(VALIDATOR_TX_PREFIX):]
+        pk_hex, _, power_s = body.partition(b"!")
+        try:
+            pub_key = bytes.fromhex(pk_hex.decode())
+            power = int(power_s)
+            if len(pub_key) != 32 or power < 0:
+                raise ValueError
+        except ValueError:
+            return t.ResponseDeliverTx(
+                code=1, log=f"invalid validator tx {tx!r}"
+            )
+        vu = t.ValidatorUpdate("ed25519", pub_key, power)
+        self._update_validator(vu)
+        self.val_updates.append(vu)
+        return t.ResponseDeliverTx(code=t.CODE_TYPE_OK)
+
+    def _update_validator(self, vu: t.ValidatorUpdate) -> None:
+        hx = vu.pub_key.hex()
+        if vu.power == 0:
+            self.validators.pop(hx, None)
+        else:
+            self.validators[hx] = vu.power
+
+    def end_block(self, req: t.RequestEndBlock) -> t.ResponseEndBlock:
+        return t.ResponseEndBlock(validator_updates=self.val_updates)
+
+    def commit(self, req: t.RequestCommit) -> t.ResponseCommit:
+        self.app_hash = struct.pack(">Q", self.size)
+        self.height += 1
+        self.db.set(_STATE_KEY, json.dumps({
+            "size": self.size,
+            "height": self.height,
+            "app_hash": self.app_hash.hex(),
+            "validators": self.validators,
+        }).encode())
+        resp = t.ResponseCommit(data=self.app_hash)
+        if self.retain_blocks > 0 and self.height > self.retain_blocks:
+            resp.retain_height = self.height - self.retain_blocks
+        return resp
+
+    def query(self, req: t.RequestQuery) -> t.ResponseQuery:
+        if req.path == "/val":
+            hx = req.data.decode()
+            power = self.validators.get(hx, 0)
+            return t.ResponseQuery(key=req.data, value=str(power).encode())
+        return super().query(req)
+
+    # -- snapshots: one snapshot of the full kv state per height kept --
+
+    def _snapshot_payload(self) -> bytes:
+        kvs = {
+            k.hex(): v.hex()
+            for k, v in self.db.iterate_prefix(b"kv:")
+        }
+        return json.dumps({
+            "kvs": kvs, "size": self.size, "height": self.height,
+            "app_hash": self.app_hash.hex(), "validators": self.validators,
+        }, sort_keys=True).encode()
+
+    def list_snapshots(self, req: t.RequestListSnapshots) -> t.ResponseListSnapshots:
+        if self.height == 0:
+            return t.ResponseListSnapshots()
+        from ..crypto import tmhash
+
+        payload = self._snapshot_payload()
+        n = max(1, -(-len(payload) // self.SNAPSHOT_CHUNK_SIZE))
+        return t.ResponseListSnapshots([
+            t.Snapshot(self.height, 1, n, tmhash.sum256(payload))
+        ])
+
+    def load_snapshot_chunk(
+        self, req: t.RequestLoadSnapshotChunk
+    ) -> t.ResponseLoadSnapshotChunk:
+        payload = self._snapshot_payload()
+        start = req.chunk * self.SNAPSHOT_CHUNK_SIZE
+        return t.ResponseLoadSnapshotChunk(
+            payload[start : start + self.SNAPSHOT_CHUNK_SIZE]
+        )
+
+    def offer_snapshot(self, req: t.RequestOfferSnapshot) -> t.ResponseOfferSnapshot:
+        if req.snapshot is None or req.snapshot.format != 1:
+            return t.ResponseOfferSnapshot(t.OfferSnapshotResult.REJECT_FORMAT)
+        self._restore_chunks: list[bytes] = []
+        self._restore_snapshot = req.snapshot
+        return t.ResponseOfferSnapshot(t.OfferSnapshotResult.ACCEPT)
+
+    def apply_snapshot_chunk(
+        self, req: t.RequestApplySnapshotChunk
+    ) -> t.ResponseApplySnapshotChunk:
+        self._restore_chunks.append(req.chunk)
+        if len(self._restore_chunks) < self._restore_snapshot.chunks:
+            return t.ResponseApplySnapshotChunk(t.ApplySnapshotChunkResult.ACCEPT)
+        d = json.loads(b"".join(self._restore_chunks))
+        ops: list[tuple[bytes, bytes | None]] = [
+            (bytes.fromhex(k), bytes.fromhex(v)) for k, v in d["kvs"].items()
+        ]
+        self.size = d["size"]
+        self.height = d["height"]
+        self.app_hash = bytes.fromhex(d["app_hash"])
+        self.validators = d["validators"]
+        ops.append((_STATE_KEY, json.dumps({
+            "size": self.size, "height": self.height,
+            "app_hash": self.app_hash.hex(), "validators": self.validators,
+        }).encode()))
+        self.db.write_batch(ops)
+        return t.ResponseApplySnapshotChunk(t.ApplySnapshotChunkResult.ACCEPT)

@@ -1,0 +1,219 @@
+"""Key-value database abstraction (the reference delegates to tm-db;
+store/store.go:33 and state/store.go assume get/set/batch/iterate).
+
+MemDB: sorted in-memory map. FileDB: crash-safe append-only record log
+with an in-memory index — every set/delete appends a crc-framed record;
+atomic batches append one multi-record entry; compaction rewrites the
+live set. Durability here is belt-and-braces: consensus-critical
+recovery rides the WAL (consensus/wal.py), matching the reference's
+trust split between tm-db and the WAL."""
+
+from __future__ import annotations
+
+import bisect
+import os
+import struct
+import zlib
+
+
+class DB:
+    def get(self, key: bytes) -> bytes | None:
+        raise NotImplementedError
+
+    def has(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def set(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    def write_batch(self, ops: list[tuple[bytes, bytes | None]]) -> None:
+        """Atomically apply [(key, value-or-None-to-delete)]."""
+        raise NotImplementedError
+
+    def iterate(self, start: bytes = b"", end: bytes | None = None):
+        """Yield (key, value) with start <= key < end, key-ascending."""
+        raise NotImplementedError
+
+    def iterate_prefix(self, prefix: bytes):
+        end = _prefix_end(prefix)
+        return self.iterate(prefix, end)
+
+    def close(self) -> None:
+        pass
+
+
+def _prefix_end(prefix: bytes) -> bytes | None:
+    p = bytearray(prefix)
+    for i in reversed(range(len(p))):
+        if p[i] != 0xFF:
+            p[i] += 1
+            return bytes(p[: i + 1])
+    return None  # all 0xff: no upper bound
+
+
+class MemDB(DB):
+    def __init__(self):
+        self._m: dict[bytes, bytes] = {}
+        self._keys: list[bytes] = []  # sorted view, rebuilt lazily
+        self._dirty = False
+
+    def get(self, key: bytes) -> bytes | None:
+        return self._m.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        if key not in self._m:
+            self._dirty = True
+        self._m[key] = value
+
+    def delete(self, key: bytes) -> None:
+        if self._m.pop(key, None) is not None:
+            self._dirty = True
+
+    def write_batch(self, ops) -> None:
+        for k, v in ops:
+            if v is None:
+                self.delete(k)
+            else:
+                self.set(k, v)
+
+    def iterate(self, start: bytes = b"", end: bytes | None = None):
+        if self._dirty:
+            self._keys = sorted(self._m)
+            self._dirty = False
+        i = bisect.bisect_left(self._keys, start)
+        while i < len(self._keys):
+            k = self._keys[i]
+            if end is not None and k >= end:
+                return
+            if k in self._m:  # may have been deleted since sort
+                yield k, self._m[k]
+            i += 1
+
+
+# FileDB record: u32 crc | u32 len | payload; payload = batch of
+# (u8 op, u32 klen, key, [u32 vlen, value]) entries. op 0=set 1=del.
+_HDR = struct.Struct("<II")
+
+
+class FileDB(MemDB):
+    """Log-structured persistent DB. The whole live set is mirrored in
+    memory (fine at this scale; the reference's goleveldb caches
+    comparably for its working set)."""
+
+    COMPACT_RATIO = 4  # compact when log bytes > ratio * live bytes
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        self._live_bytes = 0
+        self._log_bytes = 0
+        if os.path.exists(path):
+            self._replay()
+        self._f = open(path, "ab")
+
+    def _replay(self) -> None:
+        with open(self.path, "rb") as f:
+            data = f.read()
+        pos = 0
+        while pos + _HDR.size <= len(data):
+            crc, ln = _HDR.unpack_from(data, pos)
+            body = data[pos + _HDR.size : pos + _HDR.size + ln]
+            if len(body) < ln or zlib.crc32(body) != crc:
+                break  # torn tail from a crash: drop it
+            self._apply_payload(body)
+            pos += _HDR.size + ln
+        if pos < len(data):  # truncate the torn tail
+            with open(self.path, "r+b") as f:
+                f.truncate(pos)
+        self._log_bytes = pos
+        self._live_bytes = sum(len(k) + len(v) for k, v in self._m.items())
+
+    def _apply_payload(self, body: bytes) -> None:
+        pos = 0
+        while pos < len(body):
+            op = body[pos]
+            klen = struct.unpack_from("<I", body, pos + 1)[0]
+            key = body[pos + 5 : pos + 5 + klen]
+            pos += 5 + klen
+            if op == 0:
+                vlen = struct.unpack_from("<I", body, pos)[0]
+                val = body[pos + 4 : pos + 4 + vlen]
+                pos += 4 + vlen
+                super().set(key, val)
+            else:
+                super().delete(key)
+
+    def _append(self, payload: bytes) -> None:
+        rec = _HDR.pack(zlib.crc32(payload), len(payload)) + payload
+        self._f.write(rec)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._log_bytes += len(rec)
+        if (
+            self._log_bytes > 1 << 20
+            and self._log_bytes > self.COMPACT_RATIO * max(self._live_bytes, 1)
+        ):
+            self.compact()
+
+    @staticmethod
+    def _enc_set(key: bytes, value: bytes) -> bytes:
+        return b"\x00" + struct.pack("<I", len(key)) + key + struct.pack(
+            "<I", len(value)
+        ) + value
+
+    @staticmethod
+    def _enc_del(key: bytes) -> bytes:
+        return b"\x01" + struct.pack("<I", len(key)) + key
+
+    def set(self, key: bytes, value: bytes) -> None:
+        old = self._m.get(key)
+        super().set(key, value)
+        self._live_bytes += len(value) - (len(old) if old is not None else -len(key))
+        self._append(self._enc_set(key, value))
+
+    def delete(self, key: bytes) -> None:
+        old = self._m.get(key)
+        if old is not None:
+            self._live_bytes -= len(key) + len(old)
+        super().delete(key)
+        self._append(self._enc_del(key))
+
+    def write_batch(self, ops) -> None:
+        payload = bytearray()
+        for k, v in ops:
+            old = self._m.get(k)
+            if v is None:
+                if old is not None:
+                    self._live_bytes -= len(k) + len(old)
+                MemDB.delete(self, k)
+                payload += self._enc_del(k)
+            else:
+                self._live_bytes += len(v) - (
+                    len(old) if old is not None else -len(k)
+                )
+                MemDB.set(self, k, v)
+                payload += self._enc_set(k, v)
+        if payload:
+            self._append(bytes(payload))
+
+    def compact(self) -> None:
+        tmp = self.path + ".compact"
+        with open(tmp, "wb") as f:
+            size = 0
+            for k in sorted(self._m):
+                payload = self._enc_set(k, self._m[k])
+                rec = _HDR.pack(zlib.crc32(payload), len(payload)) + payload
+                f.write(rec)
+                size += len(rec)
+            f.flush()
+            os.fsync(f.fileno())
+        self._f.close()
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "ab")
+        self._log_bytes = size
+
+    def close(self) -> None:
+        self._f.close()

@@ -1,0 +1,32 @@
+"""proxy.AppConns — four logical ABCI connections to one app
+(reference: proxy/app_conn.go:15-56, proxy/multi_app_conn.go).
+
+consensus / mempool / query / snapshot each get their own client so a
+slow query can't head-of-line-block consensus. With a LocalClient they
+share one app lock; with sockets they are four connections."""
+
+from __future__ import annotations
+
+from ..abci.client import Client, ClientCreator
+from ..libs.service import Service
+
+
+class AppConns(Service):
+    def __init__(self, creator: ClientCreator):
+        super().__init__(name="proxy.AppConns")
+        self.consensus: Client = creator.new_client()
+        self.mempool: Client = creator.new_client()
+        self.query: Client = creator.new_client()
+        self.snapshot: Client = creator.new_client()
+
+    def _all(self) -> list[Client]:
+        return [self.consensus, self.mempool, self.query, self.snapshot]
+
+    async def on_start(self) -> None:
+        for c in self._all():
+            await c.start()
+
+    async def on_stop(self) -> None:
+        for c in self._all():
+            if c.is_running:
+                await c.stop()

@@ -67,11 +67,12 @@ class BlockID:
     def key(self) -> bytes:
         """Unambiguous map key: length-framed so no two distinct BlockIDs
         collide (an unframed concat would let a crafted 68-byte 'hash'
-        impersonate hash+part_set_header)."""
+        impersonate hash+part_set_header). 4-byte frame: peer-supplied
+        hashes can be oversized and must not crash the keyer."""
         psh = self.part_set_header
-        out = len(self.hash).to_bytes(1, "big") + self.hash
+        out = len(self.hash).to_bytes(4, "big") + self.hash
         if psh is not None:
-            out += b"\x01" + psh.total.to_bytes(4, "big") + psh.hash
+            out += b"\x01" + (psh.total & 0xFFFFFFFF).to_bytes(4, "big") + psh.hash
         return out
 
     def __repr__(self) -> str:
@@ -314,21 +315,27 @@ class Header:
             vw = Writer()
             vw.varint(1, self.version_block)
             vw.varint(2, self.version_app)
+
+            def bv(b: bytes) -> bytes:
+                # cdcEncode wraps byte fields in a BytesValue message
+                # (field 1, length-delimited) before hashing
+                return Writer().bytes(1, b).finish()
+
             fields = [
                 vw.finish(),
                 Writer().string(1, self.chain_id).finish(),
                 Writer().varint(1, self.height).finish(),
                 (canonical.timestamp_writer(self.time) or Writer()).finish(),
                 (block_id_writer(self.last_block_id) or Writer()).finish(),
-                self.last_commit_hash,
-                self.data_hash,
-                self.validators_hash,
-                self.next_validators_hash,
-                self.consensus_hash,
-                self.app_hash,
-                self.last_results_hash,
-                self.evidence_hash,
-                self.proposer_address,
+                bv(self.last_commit_hash),
+                bv(self.data_hash),
+                bv(self.validators_hash),
+                bv(self.next_validators_hash),
+                bv(self.consensus_hash),
+                bv(self.app_hash),
+                bv(self.last_results_hash),
+                bv(self.evidence_hash),
+                bv(self.proposer_address),
             ]
             self._hash = merkle.hash_from_byte_slices(fields)
         return self._hash

@@ -132,23 +132,19 @@ class VoteSet:
         existing = self.votes[val_index]
         conflicting: Vote | None = None
 
+        bv = self.votes_by_block.get(block_key)
         if existing is not None and _block_key(existing.block_id) != block_key:
             conflicting = existing
             # Only accept the new vote into a block's tally if a peer
             # claims +2/3 for that block (reference vote_set.go:231).
-            bv = self.votes_by_block.get(block_key)
             if bv is None or not bv.peer_maj23:
                 raise ConflictingVoteError(existing, vote)
-        else:
-            if existing is None:
-                self.votes[val_index] = vote
-                self.votes_bit_array.set(val_index, True)
-                self.sum += power
+        elif existing is None:
+            self.votes[val_index] = vote
+            self.votes_bit_array.set(val_index, True)
+            self.sum += power
 
-        bv = self.votes_by_block.get(block_key)
         if bv is None:
-            if conflicting is not None:
-                raise ConflictingVoteError(conflicting, vote)
             bv = _BlockVotes.new(False, self.size())
             self.votes_by_block[block_key] = bv
 
@@ -170,6 +166,10 @@ class VoteSet:
 
     def set_peer_maj23(self, peer_id: str, block_id: BlockID) -> None:
         """A peer claims +2/3 for block_id (reference vote_set.go:290)."""
+        try:
+            block_id.validate_basic()  # untrusted input: bound the hash
+        except ValueError as e:
+            raise VoteSetError(f"invalid peer maj23 block id: {e}") from e
         block_key = _block_key(block_id)
         existing = self.peer_maj23s.get(peer_id)
         if existing is not None:
@@ -190,7 +190,7 @@ class VoteSet:
         if v is not None and _block_key(v.block_id) == block_key:
             return v
         bv = self.votes_by_block.get(block_key)
-        if bv is not None:
+        if bv is not None and 0 <= val_index < len(bv.votes):
             return bv.votes[val_index]
         return None
 

@@ -1,0 +1,203 @@
+"""ABCI: codec round-trips, local + socket transports, kvstore apps,
+AppConns multiplexer."""
+
+import asyncio
+
+from tendermint_tpu.abci import types as t
+from tendermint_tpu.abci.client import ClientCreator, LocalClient
+from tendermint_tpu.abci.kvstore import (
+    KVStoreApp, PersistentKVStoreApp, encode_validator_tx,
+)
+from tendermint_tpu.abci.server import SocketServer
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.proxy import AppConns
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_codec_roundtrip():
+    msgs = [
+        t.RequestEcho("hello"),
+        t.RequestInfo("v1", 11, 8),
+        t.RequestDeliverTx(b"\x00\xffbinary"),
+        t.RequestBeginBlock(
+            hash=b"\x01" * 32,
+            header={"height": 5},
+            last_commit_info=t.LastCommitInfo(
+                round=1, votes=[t.VoteInfo(b"\xaa" * 20, 10, True)]
+            ),
+        ),
+        t.ResponseCheckTx(code=3, log="bad", gas_wanted=7),
+        t.ResponseEndBlock(
+            validator_updates=[t.ValidatorUpdate("ed25519", b"\x02" * 32, 5)]
+        ),
+        t.ResponseListSnapshots([t.Snapshot(9, 1, 3, b"h" * 32, b"meta")]),
+        t.RequestOfferSnapshot(t.Snapshot(9, 1, 3, b"h" * 32), b"a" * 32),
+    ]
+    for m in msgs:
+        assert t.decode_msg(t.encode_msg(m)) == m
+
+
+def test_kvstore_app_flow():
+    async def go():
+        app = KVStoreApp()
+        client = LocalClient(app)
+        await client.start()
+        info = await client.info(t.RequestInfo())
+        assert info.last_block_height == 0
+        r = await client.deliver_tx(t.RequestDeliverTx(b"name=satoshi"))
+        assert r.is_ok()
+        c = await client.commit()
+        assert c.data != b""
+        q = await client.query(t.RequestQuery(data=b"name"))
+        assert q.value == b"satoshi"
+        q2 = await client.query(t.RequestQuery(data=b"missing"))
+        assert q2.value == b""
+        info2 = await client.info(t.RequestInfo())
+        assert info2.last_block_height == 1
+        await client.stop()
+
+    run(go())
+
+
+def test_persistent_kvstore_restart_and_validators():
+    async def go():
+        db = MemDB()
+        app = PersistentKVStoreApp(db)
+        client = LocalClient(app)
+        await client.start()
+        pk = b"\x07" * 32
+        r = await client.deliver_tx(
+            t.RequestDeliverTx(encode_validator_tx(pk.hex(), 42))
+        )
+        assert r.is_ok()
+        eb = await client.end_block(t.RequestEndBlock(1))
+        assert eb.validator_updates == [t.ValidatorUpdate("ed25519", pk, 42)]
+        await client.commit()
+        q = await client.query(t.RequestQuery(data=pk.hex().encode(), path="/val"))
+        assert q.value == b"42"
+        await client.stop()
+
+        # restart from the same db: height + validators survive
+        app2 = PersistentKVStoreApp(db)
+        client2 = LocalClient(app2)
+        await client2.start()
+        info = await client2.info(t.RequestInfo())
+        assert info.last_block_height == 1
+        q = await client2.query(t.RequestQuery(data=pk.hex().encode(), path="/val"))
+        assert q.value == b"42"
+        await client2.stop()
+
+    run(go())
+
+
+def test_persistent_kvstore_snapshots():
+    async def go():
+        app = PersistentKVStoreApp()
+        c = LocalClient(app)
+        await c.start()
+        for i in range(5):
+            await c.deliver_tx(t.RequestDeliverTx(b"k%d=v%d" % (i, i)))
+        await c.commit()
+        snaps = (await c.list_snapshots()).snapshots
+        assert len(snaps) == 1 and snaps[0].height == 1
+
+        # restore into a fresh app
+        app2 = PersistentKVStoreApp()
+        c2 = LocalClient(app2)
+        await c2.start()
+        offer = await c2.offer_snapshot(
+            t.RequestOfferSnapshot(snaps[0], app.app_hash)
+        )
+        assert offer.result == t.OfferSnapshotResult.ACCEPT
+        for i in range(snaps[0].chunks):
+            chunk = (await c.load_snapshot_chunk(
+                t.RequestLoadSnapshotChunk(snaps[0].height, 1, i)
+            )).chunk
+            r = await c2.apply_snapshot_chunk(
+                t.RequestApplySnapshotChunk(i, chunk)
+            )
+            assert r.result == t.ApplySnapshotChunkResult.ACCEPT
+        assert app2.app_hash == app.app_hash
+        assert app2.db.get(b"kv:k3") == b"v3"
+        await c.stop()
+        await c2.stop()
+
+    run(go())
+
+
+def test_socket_transport_pipelined():
+    async def go():
+        app = KVStoreApp()
+        server = SocketServer(app, port=0)
+        await server.start()
+        from tendermint_tpu.abci.client import SocketClient
+
+        client = SocketClient("127.0.0.1", server.port)
+        await client.start()
+        echo = await client.echo("ping")
+        assert echo.message == "ping"
+        # pipeline 50 DeliverTxs without awaiting each
+        tasks = [
+            client.submit(t.RequestDeliverTx(b"k%d=v%d" % (i, i)))
+            for i in range(50)
+        ]
+        results = await asyncio.gather(*tasks)
+        assert all(r.is_ok() for r in results)
+        await client.flush()
+        c = await client.commit()
+        assert c.data != b""
+        q = await client.query(t.RequestQuery(data=b"k17"))
+        assert q.value == b"v17"
+        await client.stop()
+        await server.stop()
+
+    run(go())
+
+
+def test_socket_server_survives_app_exception():
+    class BadApp(t.Application):
+        def deliver_tx(self, req):
+            raise RuntimeError("boom")
+
+    async def go():
+        server = SocketServer(BadApp(), port=0)
+        await server.start()
+        from tendermint_tpu.abci.client import ABCIClientError, SocketClient
+
+        client = SocketClient("127.0.0.1", server.port)
+        await client.start()
+        try:
+            await client.deliver_tx(t.RequestDeliverTx(b"x"))
+            raise AssertionError("expected ABCIClientError")
+        except ABCIClientError:
+            pass
+        # connection still alive for the next request
+        echo = await client.echo("still-here")
+        assert echo.message == "still-here"
+        await client.stop()
+        await server.stop()
+
+    run(go())
+
+
+def test_app_conns_share_one_app():
+    async def go():
+        app = KVStoreApp()
+        conns = AppConns(ClientCreator(app=app))
+        await conns.start()
+        await conns.consensus.deliver_tx(t.RequestDeliverTx(b"a=1"))
+        await conns.consensus.commit()
+        q = await conns.query.query(t.RequestQuery(data=b"a"))
+        assert q.value == b"1"
+        ct = await conns.mempool.check_tx(t.RequestCheckTx(b"b=2"))
+        assert ct.is_ok()
+        await conns.stop()
+
+    run(go())

@@ -1,0 +1,197 @@
+"""BlockExecutor end-to-end against the kvstore app: validate, execute,
+commit, state transition, valset updates, events, failure cases."""
+
+import asyncio
+
+from tendermint_tpu.abci import types as t
+from tendermint_tpu.abci.client import LocalClient
+from tendermint_tpu.abci.kvstore import PersistentKVStoreApp, encode_validator_tx
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.state import make_genesis_state
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.store import Store
+from tendermint_tpu.state.validation import BlockValidationError
+from tendermint_tpu.types.events import EventBus, QUERY_NEW_BLOCK
+from tendermint_tpu.libs.pubsub import Query
+
+from helpers import commit_for, make_genesis, next_block
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def make_executor(n_vals=4, app=None, event_bus=None):
+    gdoc, pvs = make_genesis(n_vals)
+    state = make_genesis_state(gdoc)
+    store = Store(MemDB())
+    store.save(state)
+    app = app or PersistentKVStoreApp()
+    client = LocalClient(app)
+    executor = BlockExecutor(store, client, event_bus=event_bus)
+    return state, pvs, executor, client, app
+
+
+async def apply_n_blocks(state, pvs, executor, n, txs_for=lambda h: []):
+    last_commit = None
+    for _ in range(n):
+        block, bid = next_block(state, pvs, last_commit, None)
+        block.data.txs = txs_for(block.header.height)
+        # rebuild header data_hash after tx injection
+        block.header.data_hash = block.data.hash()
+        block.header._hash = None
+        bid = block.block_id()
+        seen = commit_for(state, pvs, block, bid)
+        state, _ = await executor.apply_block(state, bid, block)
+        last_commit = seen
+    return state, last_commit
+
+
+def test_apply_three_blocks_with_txs():
+    async def go():
+        state, pvs, executor, client, app = make_executor()
+        await client.start()
+        state, _ = await apply_n_blocks(
+            state, pvs, executor, 3,
+            txs_for=lambda h: [b"h%d=x" % h],
+        )
+        assert state.last_block_height == 3
+        assert app.size == 3  # three txs delivered
+        assert state.app_hash == app.app_hash
+        # abci responses were persisted per height
+        for h in (1, 2, 3):
+            resp = executor.store.load_abci_responses(h)
+            assert len(resp["deliver_txs"]) == 1
+        # last_results_hash covers height 2's results in height 3's state?
+        # (state after block N holds results hash OF block N)
+        assert state.last_results_hash != b""
+        await client.stop()
+
+    run(go())
+
+
+def test_validation_rejects_bad_blocks():
+    async def go():
+        state, pvs, executor, client, _ = make_executor()
+        await client.start()
+        block, bid = next_block(state, pvs, None)
+
+        # wrong app hash
+        bad = state.copy()
+        bad.app_hash = b"\x99" * 32
+        try:
+            executor.validate_block(bad, block)
+            raise AssertionError("expected app-hash rejection")
+        except BlockValidationError:
+            pass
+
+        # tampered tx payload breaks data hash
+        block2, bid2 = next_block(state, pvs, None)
+        block2.data.txs = [b"evil"]
+        try:
+            executor.validate_block(state, block2)
+            raise AssertionError("expected data-hash rejection")
+        except (BlockValidationError, ValueError):
+            pass
+
+        # wrong height
+        block3, _ = next_block(state, pvs, None)
+        block3.header.height = 5
+        block3.header._hash = None
+        try:
+            executor.validate_block(state, block3)
+            raise AssertionError("expected height rejection")
+        except (BlockValidationError, ValueError):
+            pass
+        await client.stop()
+
+    run(go())
+
+
+def test_invalid_last_commit_rejected():
+    async def go():
+        state, pvs, executor, client, _ = make_executor()
+        await client.start()
+        # apply block 1
+        state, last_commit = await apply_n_blocks(state, pvs, executor, 1)
+        # block 2 with a corrupted last-commit signature
+        block, bid = next_block(state, pvs, last_commit)
+        block.last_commit.signatures[0].signature = b"\x00" * 64
+        block.header.last_commit_hash = block.last_commit.hash()
+        block.header._hash = None
+        bid = block.block_id()
+        try:
+            await executor.apply_block(state, bid, block)
+            raise AssertionError("expected commit-sig rejection")
+        except BlockValidationError:
+            pass
+        await client.stop()
+
+    run(go())
+
+
+def test_validator_updates_flow_into_state():
+    async def go():
+        state, pvs, executor, client, app = make_executor()
+        await client.start()
+        new_pk = b"\x21" * 32
+        state, _ = await apply_n_blocks(
+            state, pvs, executor, 1,
+            txs_for=lambda h: [encode_validator_tx(new_pk.hex(), 99)],
+        )
+        # new validator appears in next_validators at H+2
+        assert len(state.next_validators) == 5
+        assert len(state.validators) == 4
+        found = [
+            v for v in state.next_validators.validators
+            if v.pub_key.bytes() == new_pk
+        ]
+        assert found and found[0].voting_power == 99
+        await client.stop()
+
+    run(go())
+
+
+def test_new_block_events_published():
+    async def go():
+        bus = EventBus()
+        state, pvs, executor, client, _ = make_executor(event_bus=bus)
+        await client.start()
+        sub = bus.subscribe("test", QUERY_NEW_BLOCK)
+        tx_sub = bus.subscribe("test", Query.parse("tm.event = 'Tx'"))
+        state, _ = await apply_n_blocks(
+            state, pvs, executor, 1, txs_for=lambda h: [b"a=1"]
+        )
+        msg = await asyncio.wait_for(sub.next(), 1)
+        assert msg.data.block.header.height == 1
+        tx_msg = await asyncio.wait_for(tx_sub.next(), 1)
+        assert tx_msg.data.tx == b"a=1"
+        await client.stop()
+
+    run(go())
+
+
+def test_create_proposal_block_is_valid():
+    async def go():
+        state, pvs, executor, client, _ = make_executor()
+        await client.start()
+        # height 1 proposal from the scheduled proposer
+        proposer = state.validators.get_proposer().address
+        block = executor.create_proposal_block(1, state, None, proposer)
+        executor.validate_block(state, block)
+        bid = block.block_id()
+        seen = commit_for(state, pvs, block, bid)
+        state2, _ = await executor.apply_block(state, bid, block)
+        assert state2.last_block_height == 1
+
+        # height 2 proposal carries the commit for height 1
+        proposer2 = state2.validators.get_proposer().address
+        block2 = executor.create_proposal_block(2, state2, seen, proposer2)
+        executor.validate_block(state2, block2)
+        await client.stop()
+
+    run(go())

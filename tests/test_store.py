@@ -1,0 +1,128 @@
+"""BlockStore + state Store round-trips and pruning."""
+
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.state.store import Store
+from tendermint_tpu.store import BlockStore
+
+from helpers import (
+    commit_for, make_genesis_state_and_pvs, next_block,
+)
+
+
+def build_chain(n_blocks: int, n_vals: int = 4):
+    """Returns (blocks, commits, states) — states[i] is the state BEFORE
+    block i+1 (statically built, no app execution: state just advances
+    its height/time/valsets via the commit chain)."""
+    state, pvs = make_genesis_state_and_pvs(n_vals)
+    blocks, commits = [], []
+    last_commit = None
+    for _ in range(n_blocks):
+        block, bid = next_block(state, pvs, last_commit)
+        seen = commit_for(state, pvs, block, bid)
+        blocks.append(block)
+        commits.append(seen)
+        # manual state advance (no execution here)
+        state = state.copy()
+        state.last_block_height = block.header.height
+        state.last_block_id = bid
+        state.last_block_time = block.header.time
+        state.last_validators = state.validators.copy()
+        state.validators = state.next_validators.copy()
+        nv = state.next_validators.copy()
+        nv.increment_proposer_priority(1)
+        state.next_validators = nv
+        last_commit = seen
+    return blocks, commits, state, pvs
+
+
+def test_blockstore_save_load():
+    bs = BlockStore(MemDB())
+    blocks, commits, _, _ = build_chain(3)
+    for block, seen in zip(blocks, commits):
+        bs.save_block(block, block.make_part_set(), seen)
+    assert bs.height == 3 and bs.base == 1
+
+    b2 = bs.load_block(2)
+    assert b2 is not None and b2.hash() == blocks[1].hash()
+    meta = bs.load_block_meta(2)
+    assert meta.block_id.hash == blocks[1].hash()
+    assert bs.load_block_by_hash(blocks[2].hash()).header.height == 3
+    # commit for height 2 came from block 3's LastCommit
+    assert bs.load_block_commit(2).height == 2
+    assert bs.load_seen_commit(3).height == 3
+    assert bs.load_block(99) is None
+
+    part = bs.load_block_part(2, 0)
+    assert part is not None and part.proof.verify(
+        meta.block_id.part_set_header.hash, part.bytes_
+    )
+
+
+def test_blockstore_prune():
+    bs = BlockStore(MemDB())
+    blocks, commits, _, _ = build_chain(5)
+    for block, seen in zip(blocks, commits):
+        bs.save_block(block, block.make_part_set(), seen)
+    pruned = bs.prune_blocks(4)
+    assert pruned == 3
+    assert bs.base == 4
+    assert bs.load_block(2) is None
+    assert bs.load_block(4) is not None
+
+
+def test_blockstore_rejects_gap():
+    bs = BlockStore(MemDB())
+    blocks, commits, _, _ = build_chain(3)
+    bs.save_block(blocks[0], blocks[0].make_part_set(), commits[0])
+    try:
+        bs.save_block(blocks[2], blocks[2].make_part_set(), commits[2])
+        raise AssertionError("expected gap rejection")
+    except ValueError:
+        pass
+
+
+def test_state_store_roundtrip():
+    db = MemDB()
+    store = Store(db)
+    state, _ = make_genesis_state_and_pvs(4)
+    store.save(state)
+    loaded = store.load()
+    assert loaded.chain_id == state.chain_id
+    assert loaded.last_block_height == 0
+    assert loaded.validators.hash() == state.validators.hash()
+    assert loaded.next_validators.hash() == state.next_validators.hash()
+    # proposer priorities round-trip exactly (consensus-critical)
+    assert [v.proposer_priority for v in loaded.validators.validators] == [
+        v.proposer_priority for v in state.validators.validators
+    ]
+    # valset for the initial height was stored
+    vs = store.load_validators(1)
+    assert vs is not None and vs.hash() == state.validators.hash()
+
+
+def test_state_store_abci_responses():
+    from tendermint_tpu.abci import types as t
+
+    store = Store(MemDB())
+    responses = {
+        "begin_block": t.ResponseBeginBlock(),
+        "deliver_txs": [t.ResponseDeliverTx(code=0, data=b"ok"),
+                        t.ResponseDeliverTx(code=5, log="err")],
+        "end_block": t.ResponseEndBlock(),
+    }
+    store.save_abci_responses(7, responses)
+    loaded = store.load_abci_responses(7)
+    assert loaded["deliver_txs"] == responses["deliver_txs"]
+    assert loaded["end_block"] == responses["end_block"]
+    assert store.load_abci_responses(8) is None
+
+
+def test_state_store_prune():
+    store = Store(MemDB())
+    state, _ = make_genesis_state_and_pvs(2)
+    store.save(state)
+    for h in range(1, 10):
+        store.save_validator_set(h, state.validators)
+    store.prune_states(1, 8)
+    assert store.load_validators(3) is None
+    assert store.load_validators(9) is not None
