@@ -160,9 +160,11 @@ class SocketClient(Client):
             while True:
                 resp = await read_frame(self._reader)
                 fut = await self._pending.get()
+                if fut.done():  # caller gave up (e.g. wait_for timeout)
+                    continue
                 if isinstance(resp, t.ResponseException):
                     fut.set_exception(ABCIClientError(resp.error))
-                elif not fut.done():
+                else:
                     fut.set_result(resp)
         except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
             self._conn_err = e

@@ -44,8 +44,8 @@ class KVStoreApp(t.Application):
         return t.ResponseCheckTx(code=t.CODE_TYPE_OK, gas_wanted=1)
 
     def deliver_tx(self, req: t.RequestDeliverTx) -> t.ResponseDeliverTx:
-        key, _, value = req.tx.partition(b"=")
-        if not value:
+        key, sep, value = req.tx.partition(b"=")
+        if not sep:
             key = value = req.tx
         self.db.set(b"kv:" + key, value)
         self.size += 1

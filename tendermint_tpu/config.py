@@ -1,0 +1,254 @@
+"""Node configuration (reference: config/config.go:55-68).
+
+One Config of per-module sections with ValidateBasic on each; TOML
+load/save mirrors the reference's config file workflow. Timeout
+defaults match config/config.go:846-875 (propose 3000ms +500/round,
+prevote/precommit 1000ms +500/round, commit 1000ms)."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class BaseConfig:
+    chain_id: str = ""
+    moniker: str = "node"
+    home: str = "."
+    fast_sync: bool = True
+    db_dir: str = "data"
+    genesis_file: str = "config/genesis.json"
+    priv_validator_key_file: str = "config/priv_validator_key.json"
+    priv_validator_state_file: str = "data/priv_validator_state.json"
+    node_key_file: str = "config/node_key.json"
+    abci: str = "builtin"  # builtin | socket
+    proxy_app: str = "kvstore"
+
+    def resolve(self, path: str) -> str:
+        return path if os.path.isabs(path) else os.path.join(self.home, path)
+
+
+@dataclass
+class RPCConfig:
+    laddr: str = "tcp://127.0.0.1:26657"
+    max_open_connections: int = 900
+    max_subscription_clients: int = 100
+    max_subscriptions_per_client: int = 5
+    timeout_broadcast_tx_commit_ms: int = 10000
+    max_body_bytes: int = 1000000
+    pprof_laddr: str = ""
+
+    def validate_basic(self) -> None:
+        if self.timeout_broadcast_tx_commit_ms < 0:
+            raise ValueError("negative broadcast timeout")
+
+
+@dataclass
+class P2PConfig:
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    seeds: str = ""
+    persistent_peers: str = ""
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+    flush_throttle_ms: int = 100
+    max_packet_msg_payload_size: int = 1024
+    send_rate: int = 5120000
+    recv_rate: int = 5120000
+    pex: bool = True
+    seed_mode: bool = False
+    allow_duplicate_ip: bool = False
+    handshake_timeout_s: int = 20
+    dial_timeout_s: int = 3
+
+    def validate_basic(self) -> None:
+        if self.max_num_inbound_peers < 0 or self.max_num_outbound_peers < 0:
+            raise ValueError("negative peer limits")
+        if self.flush_throttle_ms < 0:
+            raise ValueError("negative flush throttle")
+
+
+@dataclass
+class MempoolConfig:
+    recheck: bool = True
+    broadcast: bool = True
+    wal_dir: str = ""
+    size: int = 5000
+    max_txs_bytes: int = 1073741824
+    cache_size: int = 10000
+    keep_invalid_txs_in_cache: bool = False
+    max_tx_bytes: int = 1048576
+
+    def validate_basic(self) -> None:
+        if self.size < 0 or self.cache_size < 0 or self.max_tx_bytes < 0:
+            raise ValueError("negative mempool limits")
+
+
+@dataclass
+class StateSyncConfig:
+    enable: bool = False
+    rpc_servers: list[str] = field(default_factory=list)
+    trust_height: int = 0
+    trust_hash: str = ""
+    trust_period_s: int = 168 * 3600
+    discovery_time_s: int = 15
+    chunk_request_timeout_s: int = 10
+    chunk_fetchers: int = 4
+
+    def validate_basic(self) -> None:
+        if self.enable and self.trust_height <= 0:
+            raise ValueError("statesync requires trust_height")
+
+
+@dataclass
+class FastSyncConfig:
+    version: str = "v0"
+
+    def validate_basic(self) -> None:
+        if self.version not in ("v0", "v2"):
+            raise ValueError(f"unknown fastsync version {self.version}")
+
+
+@dataclass
+class ConsensusConfig:
+    wal_file: str = "data/cs.wal/wal"
+    # reference config/config.go:846-875
+    timeout_propose_ms: int = 3000
+    timeout_propose_delta_ms: int = 500
+    timeout_prevote_ms: int = 1000
+    timeout_prevote_delta_ms: int = 500
+    timeout_precommit_ms: int = 1000
+    timeout_precommit_delta_ms: int = 500
+    timeout_commit_ms: int = 1000
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval_ms: int = 0
+    double_sign_check_height: int = 0
+    peer_gossip_sleep_ms: int = 100
+    peer_query_maj23_sleep_ms: int = 2000
+
+    def propose_timeout(self, round_: int) -> float:
+        return (self.timeout_propose_ms
+                + self.timeout_propose_delta_ms * round_) / 1000
+
+    def prevote_timeout(self, round_: int) -> float:
+        return (self.timeout_prevote_ms
+                + self.timeout_prevote_delta_ms * round_) / 1000
+
+    def precommit_timeout(self, round_: int) -> float:
+        return (self.timeout_precommit_ms
+                + self.timeout_precommit_delta_ms * round_) / 1000
+
+    def commit_timeout(self) -> float:
+        return self.timeout_commit_ms / 1000
+
+    def validate_basic(self) -> None:
+        for name in ("timeout_propose_ms", "timeout_propose_delta_ms",
+                     "timeout_prevote_ms", "timeout_prevote_delta_ms",
+                     "timeout_precommit_ms", "timeout_precommit_delta_ms",
+                     "timeout_commit_ms", "create_empty_blocks_interval_ms",
+                     "double_sign_check_height"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"negative {name}")
+
+
+def fast_consensus_config() -> ConsensusConfig:
+    """Short timeouts for in-process tests (reference: the 10ms
+    timeout-commit test config, config/config.go:867-875)."""
+    return ConsensusConfig(
+        timeout_propose_ms=400, timeout_propose_delta_ms=100,
+        timeout_prevote_ms=200, timeout_prevote_delta_ms=100,
+        timeout_precommit_ms=200, timeout_precommit_delta_ms=100,
+        timeout_commit_ms=20, skip_timeout_commit=True,
+    )
+
+
+@dataclass
+class InstrumentationConfig:
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+    namespace: str = "tendermint"
+
+
+@dataclass
+class Config:
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    fastsync: FastSyncConfig = field(default_factory=FastSyncConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    instrumentation: InstrumentationConfig = field(
+        default_factory=InstrumentationConfig
+    )
+
+    def validate_basic(self) -> None:
+        self.rpc.validate_basic()
+        self.p2p.validate_basic()
+        self.mempool.validate_basic()
+        self.statesync.validate_basic()
+        self.fastsync.validate_basic()
+        self.consensus.validate_basic()
+
+    # -- file round trip (flat TOML-ish key=value per [section]) --
+
+    def save(self, path: str) -> None:
+        import dataclasses
+
+        lines = []
+        for section_name in ("base", "rpc", "p2p", "mempool", "statesync",
+                             "fastsync", "consensus", "instrumentation"):
+            section = getattr(self, section_name)
+            lines.append(f"[{section_name}]")
+            for f in dataclasses.fields(section):
+                v = getattr(section, f.name)
+                if isinstance(v, bool):
+                    sv = "true" if v else "false"
+                elif isinstance(v, list):
+                    sv = '"' + ",".join(v) + '"'
+                elif isinstance(v, str):
+                    sv = f'"{v}"'
+                else:
+                    sv = str(v)
+                lines.append(f"{f.name} = {sv}")
+            lines.append("")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as fh:
+            fh.write("\n".join(lines))
+
+    @classmethod
+    def load(cls, path: str) -> "Config":
+        import dataclasses
+
+        cfg = cls()
+        section = None
+        with open(path) as fh:
+            for raw in fh:
+                line = raw.strip()
+                if not line or line.startswith("#"):
+                    continue
+                if line.startswith("[") and line.endswith("]"):
+                    section = getattr(cfg, line[1:-1], None)
+                    continue
+                if section is None or "=" not in line:
+                    continue
+                key, _, val = line.partition("=")
+                key, val = key.strip(), val.strip()
+                fld = next(
+                    (f for f in dataclasses.fields(section) if f.name == key),
+                    None,
+                )
+                if fld is None:
+                    continue
+                if fld.type in ("bool", bool):
+                    setattr(section, key, val == "true")
+                elif fld.type in ("int", int):
+                    setattr(section, key, int(val))
+                elif fld.type.startswith("list") if isinstance(fld.type, str) else False:
+                    s = val.strip('"')
+                    setattr(section, key, [x for x in s.split(",") if x])
+                else:
+                    setattr(section, key, val.strip('"'))
+        return cfg

@@ -1,0 +1,9 @@
+"""Consensus engine (reference: consensus/).
+
+The Tendermint BFT state machine, asyncio-native: one serialized
+receive loop per instance (the analogue of receiveRoutine,
+consensus/state.go:686), a WAL written before acting on any message,
+a timeout ticker, and gossip hooks the reactor attaches to."""
+
+from .state import ConsensusState  # noqa: F401
+from .cstypes import RoundState, RoundStep  # noqa: F401

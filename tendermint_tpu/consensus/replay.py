@@ -1,0 +1,182 @@
+"""Handshake block-replay (reference: consensus/replay.go:201-420).
+
+On boot, reconcile three heights: the app's (ABCI Info), the state
+store's, and the block store's. The app may be behind (crashed before
+Commit) — replay stored blocks into it; tendermint state may be one
+behind the block store (crashed between SaveBlock and ApplyBlock) —
+re-apply the last block through the full executor path."""
+
+from __future__ import annotations
+
+from ..abci import types as abci_t
+from ..abci.client import Client
+from ..state import State as SmState, make_genesis_state
+from ..state.execution import (
+    BlockExecutor, abci_header_from_block, build_last_commit_info,
+    validator_updates_from_abci,
+)
+from ..state.store import Store
+from ..store import BlockStore
+from ..types.genesis import GenesisDoc
+
+
+class HandshakeError(Exception):
+    pass
+
+
+class Handshaker:
+    def __init__(self, state_store: Store, state: SmState,
+                 block_store: BlockStore, genesis_doc: GenesisDoc,
+                 event_bus=None):
+        self.state_store = state_store
+        self.initial_state = state
+        self.block_store = block_store
+        self.genesis_doc = genesis_doc
+        self.event_bus = event_bus
+        self.n_blocks_replayed = 0
+
+    async def handshake(self, app_conns) -> bytes:
+        """Returns the app hash both sides agree on after replay."""
+        info = await app_conns.query.info(abci_t.RequestInfo(
+            version="tendermint_tpu", block_version=11, p2p_version=8,
+        ))
+        app_height = info.last_block_height
+        app_hash = info.last_block_app_hash
+        if app_height < 0:
+            raise HandshakeError(f"app reported negative height {app_height}")
+
+        state = self.initial_state
+        state.app_version = info.app_version or state.app_version
+
+        app_hash = await self.replay_blocks(state, app_hash, app_height,
+                                            app_conns)
+        return app_hash
+
+    async def replay_blocks(self, state: SmState, app_hash: bytes,
+                            app_height: int, app_conns) -> bytes:
+        """reference replay.go:285 replayBlocks — all height cases."""
+        store_height = self.block_store.height
+        state_height = state.last_block_height
+
+        # genesis: app has never seen InitChain
+        if app_height == 0 and state_height == 0:
+            vals = [
+                abci_t.ValidatorUpdate(
+                    v.pub_key.type_name, v.pub_key.bytes(), v.voting_power
+                )
+                for v in state.validators.validators
+            ]
+            res = await app_conns.consensus.init_chain(abci_t.RequestInitChain(
+                time=self.genesis_doc.genesis_time,
+                chain_id=self.genesis_doc.chain_id,
+                consensus_params=state.consensus_params.to_json(),
+                validators=vals,
+                app_state_bytes=(
+                    __import__("json").dumps(self.genesis_doc.app_state).encode()
+                    if self.genesis_doc.app_state is not None else b""
+                ),
+                initial_height=self.genesis_doc.initial_height,
+            ))
+            if store_height == 0:
+                # app may amend genesis valset / params / app hash
+                if res.validators:
+                    updates = validator_updates_from_abci(res.validators)
+                    from ..types.validator_set import ValidatorSet
+
+                    if not state.validators.validators:
+                        state.validators = ValidatorSet(updates)
+                        state.next_validators = state.validators.copy()
+                        state.next_validators.increment_proposer_priority(1)
+                    else:
+                        state.next_validators = state.validators.copy()
+                if res.app_hash:
+                    state.app_hash = res.app_hash
+                    app_hash = res.app_hash
+                self.state_store.save(state)
+
+        if store_height == 0:
+            self._assert_app_hash(state, app_hash)
+            return app_hash
+
+        if store_height < app_height:
+            raise HandshakeError(
+                f"app height {app_height} ahead of block store {store_height}"
+            )
+        if state_height > store_height:
+            raise HandshakeError(
+                f"state height {state_height} ahead of block store {store_height}"
+            )
+
+        # replay blocks the app is missing, exec-only (no state updates)
+        first = app_height + 1
+        # the last block needs the FULL apply path if tendermint state is
+        # also behind (crash between SaveBlock and ApplyBlock)
+        full_apply_last = state_height < store_height
+        exec_until = store_height - 1 if full_apply_last else store_height
+
+        for h in range(first, exec_until + 1):
+            app_hash = await self._exec_block(h, app_conns)
+            self.n_blocks_replayed += 1
+
+        if full_apply_last and store_height >= first:
+            block = self.block_store.load_block(store_height)
+            if block is None:
+                raise HandshakeError(f"missing block {store_height}")
+            executor = BlockExecutor(self.state_store, app_conns.consensus,
+                                     event_bus=self.event_bus)
+            prev_state = self.state_store.load() or state
+            new_state, _ = await executor.apply_block(
+                prev_state, block.block_id(), block
+            )
+            app_hash = new_state.app_hash
+            self.n_blocks_replayed += 1
+
+        self._assert_app_hash(self.state_store.load() or state, app_hash)
+        return app_hash
+
+    async def _exec_block(self, height: int, app_conns) -> bytes:
+        """Execute one stored block against the app WITHOUT touching
+        tendermint state (reference replay.go applyBlock-to-proxy path)."""
+        import asyncio
+
+        block = self.block_store.load_block(height)
+        if block is None:
+            raise HandshakeError(f"missing block {height} in store")
+        client: Client = app_conns.consensus
+        await client.begin_block(abci_t.RequestBeginBlock(
+            hash=block.hash(),
+            header=abci_header_from_block(block),
+            last_commit_info=build_last_commit_info(
+                block, self.state_store,
+                self.initial_state.initial_height,
+            ),
+        ))
+        tasks = [client.submit(abci_t.RequestDeliverTx(tx))
+                 for tx in block.data.txs]
+        if tasks:
+            await asyncio.gather(*tasks)
+        await client.end_block(abci_t.RequestEndBlock(height))
+        res = await client.commit()
+        return res.data
+
+    def _assert_app_hash(self, state: SmState, app_hash: bytes) -> None:
+        if state.last_block_height > 0 and state.app_hash != app_hash:
+            raise HandshakeError(
+                f"app hash mismatch after replay: state "
+                f"{state.app_hash.hex()} != app {app_hash.hex()}"
+            )
+
+
+async def handshake_and_load_state(
+    config, state_store: Store, block_store: BlockStore,
+    genesis_doc: GenesisDoc, app_conns, event_bus=None,
+) -> SmState:
+    """Load-or-genesis state, handshake the app, return the
+    post-handshake state (the node assembly entry point)."""
+    state = state_store.load()
+    if state is None:
+        state = make_genesis_state(genesis_doc)
+        state_store.save(state)
+    h = Handshaker(state_store, state, block_store, genesis_doc, event_bus)
+    await h.handshake(app_conns)
+    return state_store.load() or state

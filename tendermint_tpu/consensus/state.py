@@ -1,0 +1,841 @@
+"""The Tendermint BFT state machine (reference: consensus/state.go:85).
+
+One asyncio task serializes everything (the receiveRoutine analogue,
+state.go:686-765): peer messages, internal messages (our own proposals
+and votes loop back through the same queue), and timeouts. Every
+message that can change state is WAL'd before being acted on; an
+EndHeightMessage delimits committed heights for crash recovery.
+
+Transitions (state.go:909-1596):
+  NewRound → Propose → Prevote → PrevoteWait → Precommit →
+  PrecommitWait → Commit → (apply via BlockExecutor) → NewHeight
+
+Signature verification throughout rides the BatchVerifier surfaces in
+types/ (vote_set.py, validator_set.py) — on TPU for wide batches."""
+
+from __future__ import annotations
+
+import asyncio
+import time as _time
+from dataclasses import dataclass
+
+from ..config import ConsensusConfig
+from ..libs.fail import fail
+from ..libs.service import Service
+from ..mempool import Mempool, NopMempool
+from ..state import State as SmState
+from ..state.execution import BlockExecutor
+from ..store import BlockStore
+from ..types.block import Block, BlockID, BlockIDFlag, Commit, NIL_BLOCK_ID, PartSet
+from ..types.events import (
+    EventBus, EventDataRoundState, EventDataVote,
+)
+from ..types.priv_validator import PrivValidator
+from ..types.proposal import Proposal
+from ..types.vote import Vote, VoteType
+from ..types.vote_set import ConflictingVoteError, VoteSet, VoteSetError
+from . import messages as m
+from .cstypes import HeightVoteSet, RoundState, RoundStep
+from .ticker import TimeoutTicker
+from .wal import (
+    EndHeightMessage, MsgInfo, RoundStateMessage, TimeoutInfo, WAL,
+)
+
+
+@dataclass
+class _QueuedMsg:
+    msg: object
+    peer_id: str
+
+
+class ConsensusState(Service):
+    def __init__(self, config: ConsensusConfig, state: SmState,
+                 block_exec: BlockExecutor, block_store: BlockStore,
+                 mempool: Mempool | None = None, evpool=None,
+                 wal: WAL | None = None, event_bus: EventBus | None = None):
+        super().__init__(name="consensus.State")
+        self.config = config
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.mempool = mempool or NopMempool()
+        self.evpool = evpool
+        self.wal = wal
+        self.event_bus = event_bus
+        self.priv_validator: PrivValidator | None = None
+        self.priv_validator_address: bytes | None = None
+
+        self.rs = RoundState()
+        self.state: SmState | None = None
+        self.peer_msg_queue: asyncio.Queue[_QueuedMsg] = asyncio.Queue(1000)
+        self.internal_msg_queue: asyncio.Queue[_QueuedMsg] = asyncio.Queue(1000)
+        self.ticker = TimeoutTicker()
+        self._replay_mode = False
+        self._height_done = asyncio.Event()  # pulsed on every commit
+        # reactor hooks: fn(event_name, payload); events: "step",
+        # "proposal", "block_part", "vote", "has_vote"
+        self.broadcast_hooks: list = []
+
+        self.update_to_state(state)
+        if state.last_block_height > 0:
+            self.reconstruct_last_commit()
+
+    # -- wiring --
+
+    def set_priv_validator(self, pv: PrivValidator | None) -> None:
+        self.priv_validator = pv
+        self.priv_validator_address = (
+            pv.get_pub_key().address() if pv is not None else None
+        )
+
+    def _broadcast(self, event: str, payload) -> None:
+        for hook in self.broadcast_hooks:
+            hook(event, payload)
+
+    # -- lifecycle --
+
+    async def on_start(self) -> None:
+        if self.wal is not None:
+            await self._catchup_replay()
+        self.spawn(self._receive_routine(), name="cs-receive")
+        self._schedule_round0()
+
+    async def on_stop(self) -> None:
+        self.ticker.stop()
+        if self.wal is not None:
+            self.wal.close()
+
+    def _schedule_round0(self) -> None:
+        # fire NewHeight immediately (start_time already accounts for
+        # timeout_commit when coming off a commit)
+        delay = max(self.rs.start_time - _time.monotonic(), 0.0)
+        self.ticker.schedule(TimeoutInfo(
+            delay, self.rs.height, 0, int(RoundStep.NEW_HEIGHT)
+        ))
+
+    # -- state sync between heights (reference updateToState, state.go:566) --
+
+    def update_to_state(self, state: SmState) -> None:
+        rs = self.rs
+        if rs.commit_round > -1 and 0 < rs.height != state.last_block_height:
+            raise RuntimeError(
+                f"update_to_state height mismatch {rs.height} vs "
+                f"{state.last_block_height}"
+            )
+        last_precommits: VoteSet | None = None
+        if rs.commit_round > -1 and rs.votes is not None:
+            pc = rs.votes.precommits(rs.commit_round)
+            if pc is None or not pc.has_two_thirds_majority():
+                raise RuntimeError("commit round has no +2/3 precommits")
+            last_precommits = pc
+
+        height = state.last_block_height + 1
+        if height == 1:
+            height = state.initial_height
+
+        validators = state.validators.copy()
+        self.rs = RoundState(
+            height=height,
+            round=0,
+            step=RoundStep.NEW_HEIGHT,
+            start_time=_time.monotonic() + (
+                self.config.commit_timeout()
+                if not self.config.skip_timeout_commit and rs.commit_round > -1
+                else 0.0
+            ),
+            validators=validators,
+            votes=HeightVoteSet(state.chain_id, height, validators),
+            last_commit=last_precommits,
+            last_validators=state.last_validators.copy(),
+            commit_round=-1,
+            locked_round=-1,
+            valid_round=-1,
+        )
+        self.state = state
+
+    def reconstruct_last_commit(self) -> None:
+        """Rebuild rs.last_commit from the stored seen commit
+        (reference state.go:549)."""
+        assert self.state is not None
+        seen = self.block_store.load_seen_commit(self.state.last_block_height)
+        if seen is None:
+            raise RuntimeError(
+                f"no seen commit for height {self.state.last_block_height}"
+            )
+        last_precommits = VoteSet(
+            self.state.chain_id, seen.height, seen.round,
+            VoteType.PRECOMMIT, self.state.last_validators,
+        )
+        for idx, cs_sig in enumerate(seen.signatures):
+            if cs_sig.is_absent():
+                continue
+            vote = Vote(
+                type=VoteType.PRECOMMIT,
+                height=seen.height,
+                round=seen.round,
+                block_id=cs_sig.block_id_for(seen.block_id),
+                timestamp=cs_sig.timestamp,
+                validator_address=cs_sig.validator_address,
+                validator_index=idx,
+                signature=cs_sig.signature,
+            )
+            last_precommits.add_vote(vote)
+        if not last_precommits.has_two_thirds_majority():
+            raise RuntimeError("seen commit lacks +2/3")
+        self.rs.last_commit = last_precommits
+
+    # -- the serialized event loop --
+
+    async def _receive_routine(self) -> None:
+        while True:
+            internal = asyncio.ensure_future(self.internal_msg_queue.get())
+            peer = asyncio.ensure_future(self.peer_msg_queue.get())
+            timeout = asyncio.ensure_future(self.ticker.queue.get())
+            done, pending = await asyncio.wait(
+                [internal, peer, timeout],
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            for p in pending:
+                p.cancel()
+            try:
+                if internal in done:
+                    qm = internal.result()
+                    self._wal_write_sync(MsgInfo(
+                        "", m.encode_consensus_msg(qm.msg)
+                    ))
+                    await self._handle_msg(qm)
+                if peer in done:
+                    qm = peer.result()
+                    self._wal_write(MsgInfo(
+                        qm.peer_id, m.encode_consensus_msg(qm.msg)
+                    ))
+                    await self._handle_msg(qm)
+                if timeout in done:
+                    ti = timeout.result()
+                    self._wal_write_sync(ti)
+                    await self._handle_timeout(ti)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self.logger.exception("consensus handler failed; halting")
+                raise
+
+    def _wal_write(self, msg) -> None:
+        if self.wal is not None and not self._replay_mode:
+            self.wal.write(msg, _time.time_ns())
+
+    def _wal_write_sync(self, msg) -> None:
+        if self.wal is not None and not self._replay_mode:
+            self.wal.write_sync(msg, _time.time_ns())
+
+    async def _handle_msg(self, qm: _QueuedMsg) -> None:
+        msg = qm.msg
+        if isinstance(msg, m.ProposalMessage):
+            self._set_proposal(msg.proposal)
+            # parts may have completed before the proposal arrived
+            if self.rs.proposal_complete():
+                await self._proposal_completed()
+        elif isinstance(msg, m.BlockPartMessage):
+            added = self._add_proposal_block_part(msg)
+            if added and self.rs.proposal_complete():
+                await self._proposal_completed()
+        elif isinstance(msg, m.VoteMessage):
+            await self._try_add_vote(msg.vote, qm.peer_id)
+        else:
+            self.logger.warning("unknown consensus msg %r", type(msg))
+
+    async def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        rs = self.rs
+        if ti.height != rs.height or ti.round < rs.round or (
+            ti.round == rs.round and ti.step < int(rs.step)
+        ):
+            return  # stale
+        step = RoundStep(ti.step)
+        if step == RoundStep.NEW_HEIGHT:
+            await self._enter_new_round(ti.height, 0)
+        elif step == RoundStep.NEW_ROUND:
+            await self._enter_propose(ti.height, 0)
+        elif step == RoundStep.PROPOSE:
+            await self._enter_prevote(ti.height, ti.round)
+        elif step == RoundStep.PREVOTE_WAIT:
+            await self._enter_precommit(ti.height, ti.round)
+        elif step == RoundStep.PRECOMMIT_WAIT:
+            await self._enter_precommit(ti.height, ti.round)
+            await self._enter_new_round(ti.height, ti.round + 1)
+
+    # -- step transitions --
+
+    def _new_step(self, step: RoundStep) -> None:
+        self.rs.step = step
+        rsm = RoundStateMessage(self.rs.height, self.rs.round, int(step))
+        self._wal_write(rsm)
+        if self.event_bus is not None:
+            self.event_bus.publish_new_round_step(EventDataRoundState(
+                self.rs.height, self.rs.round, step.name
+            ))
+        self._broadcast("step", self.rs)
+
+    async def _enter_new_round(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step != RoundStep.NEW_HEIGHT
+        ):
+            return
+        if round_ > rs.round and rs.validators is not None:
+            # advance proposer rotation for skipped rounds
+            rs.validators.increment_proposer_priority(round_ - rs.round)
+        rs.round = round_
+        rs.step = RoundStep.NEW_ROUND
+        if round_ > 0:
+            # new round: prior proposal is void
+            rs.proposal = None
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.votes.set_round(round_)
+        rs.triggered_timeout_precommit = False
+        if self.event_bus is not None:
+            self.event_bus.publish_new_round(EventDataRoundState(
+                height, round_, "NewRound"
+            ))
+        await self._enter_propose(height, round_)
+
+    async def _enter_propose(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= RoundStep.PROPOSE
+        ):
+            return
+        rs.round = round_
+        self._new_step(RoundStep.PROPOSE)
+
+        self.ticker.schedule(TimeoutInfo(
+            self.config.propose_timeout(round_), height, round_,
+            int(RoundStep.PROPOSE),
+        ))
+
+        if self._is_proposer() and self.priv_validator is not None:
+            self._decide_proposal(height, round_)
+
+        if rs.proposal_complete():
+            await self._enter_prevote(height, round_)
+
+    def _is_proposer(self) -> bool:
+        return (
+            self.priv_validator_address is not None
+            and self.rs.validators is not None
+            and self.rs.validators.get_proposer().address
+            == self.priv_validator_address
+        )
+
+    def _decide_proposal(self, height: int, round_: int) -> None:
+        """reference defaultDecideProposal (state.go:1063)."""
+        rs = self.rs
+        if rs.valid_block is not None:
+            block, parts = rs.valid_block, rs.valid_block_parts
+        else:
+            commit = None
+            if height == self.state.initial_height:
+                commit = Commit(0, 0, NIL_BLOCK_ID, [])
+            elif rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
+                commit = rs.last_commit.make_commit()
+            else:
+                self.logger.error("cannot propose: no last commit")
+                return
+            block = self.block_exec.create_proposal_block(
+                height, self.state, commit, self.priv_validator_address,
+            )
+            parts = block.make_part_set()
+
+        block_id = BlockID(block.hash(), parts.header())
+        proposal = Proposal(
+            height=height, round=round_, pol_round=rs.valid_round,
+            block_id=block_id, timestamp=_time.time_ns(),
+        )
+        try:
+            self.priv_validator.sign_proposal(self.state.chain_id, proposal)
+        except Exception as e:
+            self.logger.error("failed to sign proposal: %r", e)
+            return
+        self._send_internal(m.ProposalMessage(proposal))
+        for i in range(parts.total):
+            self._send_internal(m.BlockPartMessage(height, round_,
+                                                   parts.get_part(i)))
+
+    def _send_internal(self, msg) -> None:
+        self.internal_msg_queue.put_nowait(_QueuedMsg(msg, ""))
+
+    async def _proposal_completed(self) -> None:
+        """Block fully received: react based on the current step
+        (reference addProposalBlockPart, state.go:1775-1840)."""
+        rs = self.rs
+        prevotes = rs.votes.prevotes(rs.round)
+        bid, has_maj = (prevotes.two_thirds_majority()
+                        if prevotes is not None else (None, False))
+        if has_maj and bid is not None and not bid.is_nil() and rs.valid_round < rs.round:
+            if rs.proposal_block.hash() == bid.hash:
+                rs.valid_round = rs.round
+                rs.valid_block = rs.proposal_block
+                rs.valid_block_parts = rs.proposal_block_parts
+        if rs.step <= RoundStep.PROPOSE and rs.proposal_complete():
+            await self._enter_prevote(rs.height, rs.round)
+            if has_maj:
+                await self._enter_precommit(rs.height, rs.round)
+        elif rs.step == RoundStep.COMMIT:
+            await self._try_finalize_commit(rs.height)
+
+    async def _enter_prevote(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= RoundStep.PREVOTE
+        ):
+            return
+        self._new_step(RoundStep.PREVOTE)
+        # reference defaultDoPrevote (state.go:1229)
+        if rs.locked_block is not None:
+            self._sign_add_vote(VoteType.PREVOTE, rs.locked_block.hash(),
+                                rs.locked_block_parts.header())
+        elif rs.proposal_block is None:
+            self._sign_add_vote(VoteType.PREVOTE, b"", None)
+        else:
+            try:
+                self.block_exec.validate_block(self.state, rs.proposal_block)
+                self._sign_add_vote(
+                    VoteType.PREVOTE, rs.proposal_block.hash(),
+                    rs.proposal_block_parts.header(),
+                )
+            except Exception as e:
+                self.logger.warning("invalid proposal block: %r", e)
+                self._sign_add_vote(VoteType.PREVOTE, b"", None)
+
+    async def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= RoundStep.PREVOTE_WAIT
+        ):
+            return
+        self._new_step(RoundStep.PREVOTE_WAIT)
+        self.ticker.schedule(TimeoutInfo(
+            self.config.prevote_timeout(round_), height, round_,
+            int(RoundStep.PREVOTE_WAIT),
+        ))
+
+    async def _enter_precommit(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= RoundStep.PRECOMMIT
+        ):
+            return
+        self._new_step(RoundStep.PRECOMMIT)
+        prevotes = rs.votes.prevotes(round_)
+        bid, has_maj = (prevotes.two_thirds_majority()
+                        if prevotes is not None else (None, False))
+
+        if not has_maj:
+            # no polka: precommit nil
+            self._sign_add_vote(VoteType.PRECOMMIT, b"", None)
+            return
+
+        if self.event_bus is not None:
+            self.event_bus.publish_polka(EventDataRoundState(
+                height, round_, "Polka"
+            ))
+
+        if bid is None or bid.is_nil():
+            # +2/3 prevoted nil: unlock and precommit nil (state.go:1320)
+            rs.locked_round = -1
+            rs.locked_block = None
+            rs.locked_block_parts = None
+            self._sign_add_vote(VoteType.PRECOMMIT, b"", None)
+            return
+
+        # +2/3 for a block
+        if rs.locked_block is not None and rs.locked_block.hash() == bid.hash:
+            rs.locked_round = round_  # re-lock at this round
+            self._sign_add_vote(VoteType.PRECOMMIT, bid.hash,
+                                bid.part_set_header)
+            return
+        if rs.proposal_block is not None and rs.proposal_block.hash() == bid.hash:
+            try:
+                self.block_exec.validate_block(self.state, rs.proposal_block)
+            except Exception as e:
+                self.logger.error("polka for invalid block: %r", e)
+                self._sign_add_vote(VoteType.PRECOMMIT, b"", None)
+                return
+            rs.locked_round = round_
+            rs.locked_block = rs.proposal_block
+            rs.locked_block_parts = rs.proposal_block_parts
+            if self.event_bus is not None:
+                self.event_bus.publish_lock(EventDataRoundState(
+                    height, round_, "Lock"
+                ))
+            self._sign_add_vote(VoteType.PRECOMMIT, bid.hash,
+                                bid.part_set_header)
+            return
+
+        # polka for a block we don't have: unlock, precommit nil, fetch
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+            bid.part_set_header
+        ):
+            rs.proposal_block = None
+            rs.proposal_block_parts = PartSet(
+                bid.part_set_header.total, bid.part_set_header.hash
+            )
+        self._sign_add_vote(VoteType.PRECOMMIT, b"", None)
+
+    async def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.triggered_timeout_precommit
+        ):
+            return
+        rs.triggered_timeout_precommit = True
+        self.ticker.schedule(TimeoutInfo(
+            self.config.precommit_timeout(round_), height, round_,
+            int(RoundStep.PRECOMMIT_WAIT),
+        ))
+
+    async def _enter_commit(self, height: int, commit_round: int) -> None:
+        rs = self.rs
+        if rs.height != height or rs.step >= RoundStep.COMMIT:
+            return
+        rs.commit_round = commit_round
+        rs.commit_time = _time.monotonic()
+        self._new_step(RoundStep.COMMIT)
+
+        precommits = rs.votes.precommits(commit_round)
+        bid, ok = precommits.two_thirds_majority()
+        assert ok and bid is not None and not bid.is_nil()
+
+        # if we have the block locked, promote it to proposal slots
+        if rs.locked_block is not None and rs.locked_block.hash() == bid.hash:
+            rs.proposal_block = rs.locked_block
+            rs.proposal_block_parts = rs.locked_block_parts
+        # if we don't have the full block yet, set up parts to receive it
+        if rs.proposal_block is None or rs.proposal_block.hash() != bid.hash:
+            if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                bid.part_set_header
+            ):
+                rs.proposal_block = None
+                rs.proposal_block_parts = PartSet(
+                    bid.part_set_header.total, bid.part_set_header.hash
+                )
+        await self._try_finalize_commit(height)
+
+    async def _try_finalize_commit(self, height: int) -> None:
+        rs = self.rs
+        if rs.height != height:
+            return
+        precommits = rs.votes.precommits(rs.commit_round)
+        bid, ok = precommits.two_thirds_majority()
+        if not ok or bid is None or bid.is_nil():
+            return
+        if rs.proposal_block is None or rs.proposal_block.hash() != bid.hash:
+            return  # don't have the block yet
+        await self._finalize_commit(height)
+
+    async def _finalize_commit(self, height: int) -> None:
+        """reference finalizeCommit (state.go:1491)."""
+        rs = self.rs
+        precommits = rs.votes.precommits(rs.commit_round)
+        bid, _ = precommits.two_thirds_majority()
+        block, parts = rs.proposal_block, rs.proposal_block_parts
+
+        block.validate_basic()
+
+        if self.block_store.height < block.header.height:
+            seen_commit = precommits.make_commit()
+            self.block_store.save_block(block, parts, seen_commit)
+
+        fail()  # crash-point: block saved, WAL end-height not yet written
+
+        if self.wal is not None and not self._replay_mode:
+            self.wal.write_sync(EndHeightMessage(height), _time.time_ns())
+
+        fail()  # crash-point: WAL delimited, state not yet applied
+
+        state_copy = self.state.copy()
+        new_state, retain_height = await self.block_exec.apply_block(
+            state_copy, bid, block
+        )
+        if retain_height > 0:
+            try:
+                pruned = self.block_store.prune_blocks(retain_height)
+                self.block_exec.store.prune_states(1, retain_height)
+                self.logger.debug("pruned %d blocks to %d", pruned, retain_height)
+            except Exception as e:
+                self.logger.error("prune failed: %r", e)
+
+        self.update_to_state(new_state)
+        self._height_done.set()
+        self._height_done = asyncio.Event()
+        self._schedule_round0()
+
+    # -- proposals & parts --
+
+    def _set_proposal(self, proposal: Proposal) -> None:
+        """reference defaultSetProposal (state.go:1719)."""
+        rs = self.rs
+        if rs.proposal is not None:
+            return
+        if proposal.height != rs.height or proposal.round != rs.round:
+            return
+        proposal.validate_basic()
+        if proposal.pol_round != -1 and not (
+            0 <= proposal.pol_round < proposal.round
+        ):
+            raise VoteSetError("invalid POL round")
+        proposer = rs.validators.get_proposer()
+        if not proposer.pub_key.verify_signature(
+            proposal.sign_bytes(self.state.chain_id), proposal.signature
+        ):
+            raise VoteSetError("invalid proposal signature")
+        rs.proposal = proposal
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet(
+                proposal.block_id.part_set_header.total,
+                proposal.block_id.part_set_header.hash,
+            )
+        self._broadcast("proposal", proposal)
+
+    def _add_proposal_block_part(self, msg: m.BlockPartMessage) -> bool:
+        rs = self.rs
+        if msg.height != rs.height:
+            return False
+        if rs.proposal_block_parts is None:
+            return False
+        added = rs.proposal_block_parts.add_part(msg.part)
+        if added and rs.proposal_block_parts.is_complete():
+            data = rs.proposal_block_parts.assemble()
+            block = Block.from_bytes(data)
+            if rs.proposal is not None and block.hash() != rs.proposal.block_id.hash:
+                raise VoteSetError("completed block hash != proposal block id")
+            rs.proposal_block = block
+            if self.event_bus is not None:
+                self.event_bus.publish_complete_proposal(EventDataRoundState(
+                    rs.height, rs.round, "CompleteProposal"
+                ))
+            self._broadcast("block_part", msg)
+        elif added:
+            self._broadcast("block_part", msg)
+        return added
+
+    # -- votes --
+
+    async def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """reference tryAddVote (state.go:1845): conflicting votes
+        become evidence; late precommits for the last height extend
+        rs.last_commit."""
+        try:
+            return await self._add_vote(vote, peer_id)
+        except ConflictingVoteError as e:
+            if self.priv_validator_address == vote.validator_address:
+                self.logger.error(
+                    "found conflicting vote from ourselves; height %d",
+                    vote.height,
+                )
+                return False
+            if self.evpool is not None and e.existing is not None:
+                from ..types.evidence import DuplicateVoteEvidence
+
+                ev = DuplicateVoteEvidence.from_votes(
+                    e.existing, vote, self.state.last_block_time,
+                    self.rs.last_validators
+                    if vote.height == self.state.last_block_height
+                    else self.rs.validators,
+                )
+                self.evpool.add_evidence_from_consensus(ev)
+            return False
+        except VoteSetError as e:
+            self.logger.debug("vote rejected: %s", e)
+            return False
+
+    async def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+        rs = self.rs
+        # late precommit for the previous height (state.go:1901)
+        if vote.height + 1 == rs.height and vote.type == VoteType.PRECOMMIT:
+            if rs.step != RoundStep.NEW_HEIGHT or rs.last_commit is None:
+                return False
+            added = rs.last_commit.add_vote(vote)
+            if added:
+                self._publish_vote(vote)
+            return added
+        if vote.height != rs.height:
+            return False
+
+        added = rs.votes.add_vote(vote, peer_id)
+        if not added:
+            return False
+        self._publish_vote(vote)
+        self._broadcast("has_vote", m.HasVoteMessage(
+            vote.height, vote.round, int(vote.type), vote.validator_index
+        ))
+
+        if vote.type == VoteType.PREVOTE:
+            await self._on_prevote_added(vote)
+        else:
+            await self._on_precommit_added(vote)
+        return True
+
+    def _publish_vote(self, vote: Vote) -> None:
+        if self.event_bus is not None:
+            self.event_bus.publish_vote(EventDataVote(vote))
+        self._broadcast("vote", vote)
+
+    async def _on_prevote_added(self, vote: Vote) -> None:
+        """reference addVote prevote handling (state.go:1950-2032)."""
+        rs = self.rs
+        prevotes = rs.votes.prevotes(vote.round)
+        bid, has_maj = prevotes.two_thirds_majority()
+
+        if has_maj and bid is not None and not bid.is_nil():
+            # unlock if a later polka contradicts our lock (state.go:1965)
+            if (rs.locked_block is not None
+                    and rs.locked_round < vote.round <= rs.round
+                    and rs.locked_block.hash() != bid.hash):
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+            # track valid block (state.go:1984)
+            if rs.valid_round < vote.round <= rs.round:
+                if rs.proposal_block is not None and rs.proposal_block.hash() == bid.hash:
+                    rs.valid_round = vote.round
+                    rs.valid_block = rs.proposal_block
+                    rs.valid_block_parts = rs.proposal_block_parts
+                elif rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                    bid.part_set_header
+                ):
+                    # polka for a block we don't have: start fetching it
+                    rs.proposal_block = None
+                    rs.proposal_block_parts = PartSet(
+                        bid.part_set_header.total, bid.part_set_header.hash
+                    )
+
+        if rs.round < vote.round and prevotes.has_two_thirds_any():
+            await self._enter_new_round(rs.height, vote.round)
+        elif rs.round == vote.round and rs.step >= RoundStep.PREVOTE:
+            if has_maj and (rs.proposal_complete() or bid is None or bid.is_nil()):
+                await self._enter_precommit(rs.height, vote.round)
+            elif prevotes.has_two_thirds_any() and rs.step == RoundStep.PREVOTE:
+                await self._enter_prevote_wait(rs.height, vote.round)
+        elif (rs.proposal is not None
+              and 0 <= rs.proposal.pol_round == vote.round
+              and rs.step == RoundStep.PROPOSE
+              and rs.proposal_complete()):
+            await self._enter_prevote(rs.height, rs.round)
+
+    async def _on_precommit_added(self, vote: Vote) -> None:
+        """reference addVote precommit handling (state.go:2034-2067)."""
+        rs = self.rs
+        precommits = rs.votes.precommits(vote.round)
+        bid, has_maj = precommits.two_thirds_majority()
+        if has_maj:
+            if bid is None or bid.is_nil():
+                # +2/3 precommitted nil: straight to the next round
+                await self._enter_new_round(rs.height, vote.round + 1)
+            else:
+                await self._enter_new_round(rs.height, vote.round)
+                await self._enter_precommit(rs.height, vote.round)
+                await self._enter_commit(rs.height, vote.round)
+                if self.config.skip_timeout_commit and precommits.has_all():
+                    await self._enter_new_round(self.rs.height, 0)
+        elif rs.round <= vote.round and precommits.has_two_thirds_any():
+            await self._enter_new_round(rs.height, vote.round)
+            await self._enter_precommit_wait(rs.height, vote.round)
+
+    def _sign_add_vote(self, type_: VoteType, hash_: bytes,
+                       part_set_header) -> Vote | None:
+        """reference signAddVote (state.go:2139)."""
+        if self.priv_validator is None or self.rs.validators is None:
+            return None
+        if not self.rs.validators.has_address(self.priv_validator_address):
+            return None
+        idx, _ = self.rs.validators.get_by_address(self.priv_validator_address)
+        block_id = (
+            BlockID(hash_, part_set_header) if hash_ else None
+        )
+        vote = Vote(
+            type=type_,
+            height=self.rs.height,
+            round=self.rs.round,
+            block_id=block_id,
+            timestamp=self._vote_time(),
+            validator_address=self.priv_validator_address,
+            validator_index=idx,
+        )
+        try:
+            self.priv_validator.sign_vote(self.state.chain_id, vote)
+        except Exception as e:
+            self.logger.error("failed to sign vote: %r", e)
+            return None
+        self._send_internal(m.VoteMessage(vote))
+        return vote
+
+    def _vote_time(self) -> int:
+        """now, but strictly after the block we're voting on
+        (reference voteTime, state.go:2120)."""
+        now = _time.time_ns()
+        time_iota = max(
+            self.state.consensus_params.block.time_iota_ms, 1
+        ) * 1_000_000
+        min_time = 0
+        if self.rs.locked_block is not None:
+            min_time = self.rs.locked_block.header.time + time_iota
+        elif self.rs.proposal_block is not None:
+            min_time = self.rs.proposal_block.header.time + time_iota
+        return max(now, min_time)
+
+    # -- WAL catchup replay (reference consensus/replay.go:94) --
+
+    async def _catchup_replay(self) -> None:
+        assert self.wal is not None
+        self.wal.repair()
+        height = self.state.last_block_height
+        msgs, found = self.wal.search_for_end_height(height)
+        if not found and height > 0:
+            return  # nothing in-flight
+        self._replay_mode = True
+        try:
+            for tm in msgs:
+                inner = tm.msg
+                if isinstance(inner, EndHeightMessage):
+                    break
+                if isinstance(inner, MsgInfo):
+                    try:
+                        cmsg = m.decode_consensus_msg(inner.msg_bytes)
+                    except ValueError:
+                        continue
+                    await self._handle_msg(_QueuedMsg(cmsg, inner.peer_id))
+                elif isinstance(inner, TimeoutInfo):
+                    # timeouts are re-derived live, not replayed
+                    pass
+        finally:
+            self._replay_mode = False
+        self.logger.info("replayed %d WAL messages for height %d",
+                         len(msgs), self.rs.height)
+
+    # -- public API (reactor / rpc) --
+
+    def add_peer_msg(self, msg, peer_id: str) -> None:
+        self.peer_msg_queue.put_nowait(_QueuedMsg(msg, peer_id))
+
+    def get_round_state(self) -> RoundState:
+        return self.rs
+
+    async def wait_for_height(self, height: int, timeout: float = 60.0) -> None:
+        deadline = _time.monotonic() + timeout
+        while self.rs.height <= height:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"height {height} not reached (at {self.rs.height})"
+                )
+            try:
+                await asyncio.wait_for(
+                    asyncio.shield(self._height_done.wait()), remaining
+                )
+            except asyncio.TimeoutError:
+                raise TimeoutError(
+                    f"height {height} not reached (at {self.rs.height})"
+                )

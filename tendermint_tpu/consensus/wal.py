@@ -1,0 +1,254 @@
+"""Consensus WAL (reference: consensus/wal.go).
+
+Append-only fsync'd log of everything the consensus state machine acts
+on, written BEFORE acting — crash recovery replays the tail of the log
+to rebuild in-flight round state. Record framing matches the
+reference's shape (wal.go:288): crc32 + length + payload, with a hard
+per-message size bound. An EndHeightMessage delimits each committed
+height (wal.go:42); recovery seeks the last one (wal.go:231)."""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from dataclasses import dataclass
+
+from ..encoding.proto import Reader, Writer
+
+MAX_MSG_SIZE = 1 << 20  # 1MB, reference wal.go maxMsgSizeBytes
+
+
+@dataclass
+class EndHeightMessage:
+    height: int
+
+
+@dataclass
+class MsgInfo:
+    """A peer or internal consensus message (votes/proposals/parts),
+    carried as its consensus-codec bytes."""
+
+    peer_id: str
+    msg_bytes: bytes
+
+
+@dataclass
+class TimeoutInfo:
+    duration_s: float
+    height: int
+    round: int
+    step: int
+
+
+@dataclass
+class RoundStateMessage:
+    """Step-transition marker (the reference WALs EventDataRoundState)."""
+
+    height: int
+    round: int
+    step: int
+
+
+@dataclass
+class TimedWALMessage:
+    time_ns: int
+    msg: object
+
+
+def _encode_wal_msg(m: TimedWALMessage) -> bytes:
+    w = Writer()
+    w.varint(1, m.time_ns)
+    inner = m.msg
+    if isinstance(inner, EndHeightMessage):
+        w.message(2, Writer().varint(1, inner.height))
+    elif isinstance(inner, MsgInfo):
+        iw = Writer()
+        iw.string(1, inner.peer_id)
+        iw.bytes(2, inner.msg_bytes)
+        w.message(3, iw)
+    elif isinstance(inner, TimeoutInfo):
+        iw = Writer()
+        iw.varint(1, int(inner.duration_s * 1e9))
+        iw.varint(2, inner.height)
+        iw.varint(3, inner.round, skip_zero=False)
+        iw.varint(4, inner.step)
+        w.message(4, iw)
+    elif isinstance(inner, RoundStateMessage):
+        iw = Writer()
+        iw.varint(1, inner.height)
+        iw.varint(2, inner.round, skip_zero=False)
+        iw.varint(3, inner.step)
+        w.message(5, iw)
+    else:
+        raise TypeError(f"unknown WAL message {type(inner).__name__}")
+    return w.finish()
+
+
+def _decode_wal_msg(data: bytes) -> TimedWALMessage:
+    r = Reader(data)
+    time_ns = 0
+    msg: object | None = None
+    while not r.at_end():
+        f, wt = r.field()
+        if f == 1:
+            time_ns = r.varint()
+        elif f == 2:
+            rr = Reader(r.bytes())
+            height = 0
+            while not rr.at_end():
+                ff, wwt = rr.field()
+                if ff == 1:
+                    height = rr.varint()
+                else:
+                    rr.skip(wwt)
+            msg = EndHeightMessage(height)
+        elif f == 3:
+            rr = Reader(r.bytes())
+            peer, mb = "", b""
+            while not rr.at_end():
+                ff, wwt = rr.field()
+                if ff == 1:
+                    peer = rr.string()
+                elif ff == 2:
+                    mb = rr.bytes()
+                else:
+                    rr.skip(wwt)
+            msg = MsgInfo(peer, mb)
+        elif f == 4:
+            rr = Reader(r.bytes())
+            dur = height = round_ = step = 0
+            while not rr.at_end():
+                ff, wwt = rr.field()
+                if ff == 1:
+                    dur = rr.varint()
+                elif ff == 2:
+                    height = rr.varint()
+                elif ff == 3:
+                    round_ = rr.varint()
+                elif ff == 4:
+                    step = rr.varint()
+                else:
+                    rr.skip(wwt)
+            msg = TimeoutInfo(dur / 1e9, height, round_, step)
+        elif f == 5:
+            rr = Reader(r.bytes())
+            height = round_ = step = 0
+            while not rr.at_end():
+                ff, wwt = rr.field()
+                if ff == 1:
+                    height = rr.varint()
+                elif ff == 2:
+                    round_ = rr.varint()
+                elif ff == 3:
+                    step = rr.varint()
+                else:
+                    rr.skip(wwt)
+            msg = RoundStateMessage(height, round_, step)
+        else:
+            r.skip(wt)
+    if msg is None:
+        raise ValueError("WAL message missing payload")
+    return TimedWALMessage(time_ns, msg)
+
+
+_FRAME = struct.Struct(">II")  # crc32, length
+
+
+class WALCorruptionError(Exception):
+    pass
+
+
+class WAL:
+    """File-backed WAL. write() buffers; write_sync() flushes + fsyncs.
+    The consensus loop write_sync's before acting on any message that
+    could change state (matching BaseWAL.WriteSync, wal.go:201)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "ab")
+
+    def write(self, msg: object, time_ns: int = 0) -> None:
+        data = _encode_wal_msg(TimedWALMessage(time_ns, msg))
+        if len(data) > MAX_MSG_SIZE:
+            raise ValueError(f"WAL message too big: {len(data)}")
+        self._f.write(_FRAME.pack(zlib.crc32(data), len(data)) + data)
+
+    def write_sync(self, msg: object, time_ns: int = 0) -> None:
+        self.write(msg, time_ns)
+        self.flush_and_sync()
+
+    def flush_and_sync(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        try:
+            self.flush_and_sync()
+        except (OSError, ValueError):
+            pass
+        self._f.close()
+
+    # -- reading --
+
+    @staticmethod
+    def decode_all(path: str, strict: bool = False) -> list[TimedWALMessage]:
+        """Read every record; on a corrupt/torn record, stop (strict=False
+        — crash tails are expected) or raise (strict=True)."""
+        out: list[TimedWALMessage] = []
+        if not os.path.exists(path):
+            return out
+        with open(path, "rb") as f:
+            data = f.read()
+        pos = 0
+        while pos + _FRAME.size <= len(data):
+            crc, ln = _FRAME.unpack_from(data, pos)
+            if ln > MAX_MSG_SIZE:
+                if strict:
+                    raise WALCorruptionError(f"record length {ln} too big")
+                break
+            body = data[pos + _FRAME.size : pos + _FRAME.size + ln]
+            if len(body) < ln or zlib.crc32(body) != crc:
+                if strict:
+                    raise WALCorruptionError("crc mismatch / torn record")
+                break
+            try:
+                out.append(_decode_wal_msg(body))
+            except ValueError:
+                if strict:
+                    raise
+                break
+            pos += _FRAME.size + ln
+        return out
+
+    def search_for_end_height(self, height: int) -> tuple[list[TimedWALMessage], bool]:
+        """Messages AFTER the EndHeightMessage for `height` (i.e. the
+        in-flight messages of height+1), and whether it was found
+        (reference wal.go:231 SearchForEndHeight)."""
+        msgs = self.decode_all(self.path)
+        idx = None
+        for i, m in enumerate(msgs):
+            if isinstance(m.msg, EndHeightMessage) and m.msg.height == height:
+                idx = i
+        if idx is None:
+            return [], False
+        return msgs[idx + 1 :], True
+
+    def repair(self) -> bool:
+        """Truncate a corrupted tail in place, keeping every valid
+        record (reference: consensus/state.go:2217 repairWalFile).
+        Returns True if anything was cut."""
+        good = self.decode_all(self.path)
+        valid_bytes = 0
+        for m in good:
+            data = _encode_wal_msg(m)
+            valid_bytes += _FRAME.size + len(data)
+        actual = os.path.getsize(self.path)
+        if actual <= valid_bytes:
+            return False
+        self._f.close()
+        with open(self.path, "r+b") as f:
+            f.truncate(valid_bytes)
+        self._f = open(self.path, "ab")
+        return True

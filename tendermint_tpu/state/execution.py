@@ -177,9 +177,12 @@ class BlockExecutor:
             self.app.submit(abci_t.RequestDeliverTx(tx))
             for tx in block.data.txs
         ]
-        deliver_txs = list(await asyncio.gather(*tasks)) if tasks else []
+        deliver_txs = (
+            list(await asyncio.gather(*tasks, return_exceptions=True))
+            if tasks else []
+        )
         for r in deliver_txs:
-            if isinstance(r, Exception):
+            if isinstance(r, BaseException):
                 raise ExecutionError(f"DeliverTx failed: {r}")
         end = await self.app.end_block(
             abci_t.RequestEndBlock(block.header.height)

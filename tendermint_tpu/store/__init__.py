@@ -13,7 +13,6 @@ import struct
 from ..libs.db import DB
 from ..types.block import Block, BlockID, Commit, Part, PartSet
 from ..types.block_meta import BlockMeta
-from ..crypto import merkle
 
 _STORE_KEY = b"blockStore"
 
@@ -47,10 +46,10 @@ class BlockStore:
             return None
         parts = []
         for i in range(meta.block_id.part_set_header.total):
-            p = self.db.get(b"P:" + _h(height) + struct.pack(">I", i))
-            if p is None:
+            raw = self.db.get(b"P:" + _h(height) + struct.pack(">I", i))
+            if raw is None:
                 return None
-            parts.append(p)
+            parts.append(Part.from_bytes(raw).bytes_)
         return Block.from_bytes(b"".join(parts))
 
     def load_block_by_hash(self, hash_: bytes) -> Block | None:
@@ -63,19 +62,7 @@ class BlockStore:
         raw = self.db.get(b"P:" + _h(height) + struct.pack(">I", index))
         if raw is None:
             return None
-        meta = self.load_block_meta(height)
-        assert meta is not None
-        # proofs are reconstructible from the full part set; store keeps
-        # raw bytes and rebuilds proofs on demand (cheap at part counts)
-        total = meta.block_id.part_set_header.total
-        chunks = []
-        for i in range(total):
-            c = self.db.get(b"P:" + _h(height) + struct.pack(">I", i))
-            if c is None:
-                return None
-            chunks.append(c)
-        _, proofs = merkle.proofs_from_byte_slices(chunks)
-        return Part(index, raw, proofs[index])
+        return Part.from_bytes(raw)
 
     def load_block_commit(self, height: int) -> Commit | None:
         """The commit for `height` as included in block height+1."""
@@ -106,7 +93,8 @@ class BlockStore:
         for i in range(parts.total):
             part = parts.get_part(i)
             assert part is not None
-            ops.append((b"P:" + _h(height) + struct.pack(">I", i), part.bytes_))
+            ops.append((b"P:" + _h(height) + struct.pack(">I", i),
+                        part.to_bytes()))
         if block.last_commit is not None:
             ops.append(
                 (b"C:" + _h(height - 1), block.last_commit.to_proto().finish())

@@ -34,8 +34,8 @@ class PartSetHeader:
         return self.total == 0 and not self.hash
 
     def validate_basic(self) -> None:
-        if self.total < 0:
-            raise ValueError("negative part set total")
+        if not 0 <= self.total < 1 << 32:
+            raise ValueError("part set total out of range")
         if self.hash and len(self.hash) != tmhash.SIZE:
             raise ValueError("bad part set hash size")
 
@@ -72,7 +72,9 @@ class BlockID:
         psh = self.part_set_header
         out = len(self.hash).to_bytes(4, "big") + self.hash
         if psh is not None:
-            out += b"\x01" + (psh.total & 0xFFFFFFFF).to_bytes(4, "big") + psh.hash
+            if not 0 <= psh.total < 1 << 32:
+                raise ValueError("part set total out of range")
+            out += b"\x01" + psh.total.to_bytes(4, "big") + psh.hash
         return out
 
     def __repr__(self) -> str:
@@ -522,6 +524,55 @@ class Part:
             raise ValueError("negative part index")
         if self.proof.index != self.index:
             raise ValueError("part proof index mismatch")
+
+    def to_proto(self) -> "Writer":
+        w = Writer()
+        w.varint(1, self.index, skip_zero=False)
+        w.bytes(2, self.bytes_, skip_empty=False)
+        pw = Writer()
+        pw.varint(1, self.proof.total)
+        pw.varint(2, self.proof.index, skip_zero=False)
+        pw.bytes(3, self.proof.leaf_hash)
+        for a in self.proof.aunts:
+            pw.bytes(4, a, skip_empty=False)
+        w.message(3, pw)
+        return w
+
+    def to_bytes(self) -> bytes:
+        return self.to_proto().finish()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Part":
+        r = Reader(data)
+        index, bytes_ = 0, b""
+        proof = merkle.Proof(0, 0, b"", [])
+        while not r.at_end():
+            f, wt = r.field()
+            if f == 1:
+                index = r.varint()
+            elif f == 2:
+                bytes_ = r.bytes()
+            elif f == 3:
+                rr = Reader(r.bytes())
+                total = pidx = 0
+                lh: bytes = b""
+                aunts: list[bytes] = []
+                while not rr.at_end():
+                    ff, wwt = rr.field()
+                    if ff == 1:
+                        total = rr.varint()
+                    elif ff == 2:
+                        pidx = rr.varint()
+                    elif ff == 3:
+                        lh = rr.bytes()
+                    elif ff == 4:
+                        aunts.append(rr.bytes())
+                    else:
+                        rr.skip(wwt)
+                proof = merkle.Proof(total, pidx, lh, aunts)
+            else:
+                r.skip(wt)
+        return cls(index, bytes_, proof)
 
 
 class PartSet:

@@ -1,0 +1,202 @@
+"""Consensus state machine: single-validator block production, a
+4-validator in-process network (the reference consensus/common_test.go
+harness analogue), restart recovery, and handshake replay."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.abci.client import ClientCreator
+from tendermint_tpu.abci.kvstore import PersistentKVStoreApp
+from tendermint_tpu.config import fast_consensus_config
+from tendermint_tpu.consensus import messages as m
+from tendermint_tpu.consensus.replay import handshake_and_load_state
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.consensus.wal import WAL
+from tendermint_tpu.libs.db import FileDB, MemDB
+from tendermint_tpu.proxy import AppConns
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.store import Store
+from tendermint_tpu.store import BlockStore
+from tendermint_tpu.types.events import EventBus
+
+from helpers import deterministic_pv, make_genesis
+
+
+class Node:
+    """One in-process validator node (stores + app + consensus)."""
+
+    def __init__(self, gdoc, pv, tmp_path=None, tag=""):
+        self.gdoc = gdoc
+        self.pv = pv
+        if tmp_path is not None:
+            self.state_db = FileDB(str(tmp_path / f"state{tag}.db"))
+            self.block_db = FileDB(str(tmp_path / f"blocks{tag}.db"))
+            self.app_db = FileDB(str(tmp_path / f"app{tag}.db"))
+            self.wal_path = str(tmp_path / f"wal{tag}")
+        else:
+            self.state_db = MemDB()
+            self.block_db = MemDB()
+            self.app_db = MemDB()
+            self.wal_path = None
+        self.cs = None
+        self.conns = None
+
+    async def start(self):
+        self.app = PersistentKVStoreApp(self.app_db)
+        self.conns = AppConns(ClientCreator(app=self.app))
+        await self.conns.start()
+        state_store = Store(self.state_db)
+        block_store = BlockStore(self.block_db)
+        state = await handshake_and_load_state(
+            None, state_store, block_store, self.gdoc, self.conns,
+        )
+        self.event_bus = EventBus()
+        executor = BlockExecutor(state_store, self.conns.consensus,
+                                 event_bus=self.event_bus)
+        wal = WAL(self.wal_path) if self.wal_path else None
+        self.cs = ConsensusState(
+            fast_consensus_config(), state, executor, block_store,
+            wal=wal, event_bus=self.event_bus,
+        )
+        self.cs.set_priv_validator(self.pv)
+        await self.cs.start()
+
+    async def stop(self):
+        if self.cs is not None and self.cs.is_running:
+            await self.cs.stop()
+        if self.conns is not None and self.conns.is_running:
+            await self.conns.stop()
+
+
+def wire_network(nodes):
+    """Relay proposals/parts/votes between all nodes (in lieu of p2p)."""
+    for i, src in enumerate(nodes):
+        def hook(event, payload, i=i):
+            for j, dst in enumerate(nodes):
+                if j == i or dst.cs is None or not dst.cs.is_running:
+                    continue
+                if event == "proposal":
+                    dst.cs.add_peer_msg(m.ProposalMessage(payload), f"n{i}")
+                elif event == "block_part":
+                    dst.cs.add_peer_msg(payload, f"n{i}")
+                elif event == "vote":
+                    dst.cs.add_peer_msg(m.VoteMessage(payload), f"n{i}")
+        src.cs.broadcast_hooks.append(hook)
+
+
+def test_single_validator_produces_blocks(tmp_path):
+    async def go():
+        gdoc, pvs = make_genesis(1)
+        node = Node(gdoc, pvs[0], tmp_path)
+        await node.start()
+        await node.cs.wait_for_height(3, timeout=30)
+        assert node.cs.state.last_block_height >= 3
+        bs = BlockStore(node.block_db)
+        assert bs.height >= 3
+        b2 = bs.load_block(2)
+        assert b2 is not None and b2.header.height == 2
+        # every block carries a full commit from height-1
+        assert b2.last_commit.height == 1
+        assert node.app.height >= 3
+        await node.stop()
+
+    asyncio.run(go())
+
+
+def test_single_validator_restart_recovers(tmp_path):
+    async def go():
+        gdoc, pvs = make_genesis(1)
+        node = Node(gdoc, pvs[0], tmp_path)
+        await node.start()
+        await node.cs.wait_for_height(2, timeout=30)
+        h_stop = node.cs.state.last_block_height
+        await node.stop()
+
+        # full restart from disk: state store + block store + app + WAL
+        node2 = Node(gdoc, pvs[0], tmp_path)
+        await node2.start()
+        assert node2.cs.state.last_block_height >= h_stop
+        await node2.cs.wait_for_height(h_stop + 2, timeout=30)
+        bs = BlockStore(node2.block_db)
+        assert bs.height >= h_stop + 2
+        await node2.stop()
+
+    asyncio.run(go())
+
+
+def test_four_validator_network(tmp_path):
+    async def go():
+        gdoc, pvs = make_genesis(4)
+        nodes = [Node(gdoc, pv) for pv in pvs]
+        for n in nodes:
+            await n.start()
+        wire_network(nodes)
+        await asyncio.gather(*[
+            n.cs.wait_for_height(3, timeout=60) for n in nodes
+        ])
+        hashes = set()
+        for n in nodes:
+            bs = BlockStore(n.block_db)
+            b = bs.load_block(3)
+            assert b is not None
+            hashes.add(b.hash())
+        assert len(hashes) == 1, "all nodes must agree on block 3"
+        for n in nodes:
+            await n.stop()
+
+    asyncio.run(go())
+
+
+def test_non_validator_node_follows(tmp_path):
+    """A node with no privval (full node) keeps up via gossip."""
+
+    async def go():
+        gdoc, pvs = make_genesis(4)
+        nodes = [Node(gdoc, pv) for pv in pvs]
+        observer = Node(gdoc, None)
+        all_nodes = nodes + [observer]
+        for n in all_nodes:
+            await n.start()
+        wire_network(all_nodes)
+        await asyncio.gather(*[
+            n.cs.wait_for_height(2, timeout=60) for n in all_nodes
+        ])
+        bs = BlockStore(observer.block_db)
+        assert bs.load_block(2) is not None
+        for n in all_nodes:
+            await n.stop()
+
+    asyncio.run(go())
+
+
+def test_handshake_replays_into_fresh_app(tmp_path):
+    """Blow away the app db only; handshake must replay all blocks
+    (the 'app crashed and lost its state' case, replay.go:285)."""
+
+    async def go():
+        gdoc, pvs = make_genesis(1)
+        node = Node(gdoc, pvs[0], tmp_path)
+        await node.start()
+        await node.cs.wait_for_height(3, timeout=30)
+        final_apphash = node.app.app_hash
+        h = node.app.height
+        await node.stop()
+
+        # new empty app db, same state/blocks
+        node.app_db = MemDB()
+        app2 = PersistentKVStoreApp(node.app_db)
+        conns = AppConns(ClientCreator(app=app2))
+        await conns.start()
+        state_store = Store(node.state_db)
+        block_store = BlockStore(node.block_db)
+        state = await handshake_and_load_state(
+            None, state_store, block_store, gdoc, conns,
+        )
+        assert app2.height == state.last_block_height
+        # replayed app must land on an app hash consistent with state
+        assert app2.app_hash == state.app_hash
+        assert app2.height >= h - 1
+        await conns.stop()
+
+    asyncio.run(go())

@@ -1,0 +1,64 @@
+"""Consensus message codec round trips."""
+
+from tendermint_tpu.consensus import messages as m
+from tendermint_tpu.crypto.merkle import Proof
+from tendermint_tpu.libs.bits import BitArray
+from tendermint_tpu.types.block import BlockID, Part, PartSetHeader
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote, VoteType
+
+
+def _bid():
+    return BlockID(b"\x01" * 32, PartSetHeader(3, b"\x02" * 32))
+
+
+def roundtrip(msg):
+    out = m.decode_consensus_msg(m.encode_consensus_msg(msg))
+    assert out == msg, f"{msg} != {out}"
+    return out
+
+
+def test_new_round_step():
+    roundtrip(m.NewRoundStepMessage(7, 2, 4, 13, 1))
+    roundtrip(m.NewRoundStepMessage(1, 0, 1))
+
+
+def test_proposal_msg():
+    p = Proposal(5, 1, -1, _bid(), timestamp=123456789, signature=b"\x55" * 64)
+    out = roundtrip(m.ProposalMessage(p))
+    assert out.proposal.pol_round == -1
+
+
+def test_block_part_msg():
+    part = Part(2, b"chunk-bytes", Proof(4, 2, b"\x03" * 32,
+                                         [b"\x04" * 32, b"\x05" * 32]))
+    out = roundtrip(m.BlockPartMessage(9, 1, part))
+    assert out.part.proof.aunts == [b"\x04" * 32, b"\x05" * 32]
+
+
+def test_vote_msg():
+    v = Vote(VoteType.PRECOMMIT, 3, 0, _bid(), 999, b"\xaa" * 20, 2,
+             b"\x66" * 64)
+    roundtrip(m.VoteMessage(v))
+    # nil vote
+    v2 = Vote(VoteType.PREVOTE, 3, 0, None, 999, b"\xaa" * 20, 2, b"\x66" * 64)
+    out = m.decode_consensus_msg(m.encode_consensus_msg(m.VoteMessage(v2)))
+    assert out.vote.is_nil()
+
+
+def test_has_vote_and_maj23():
+    roundtrip(m.HasVoteMessage(4, 0, 1, 3))
+    roundtrip(m.VoteSetMaj23Message(4, 1, 2, _bid()))
+    bits = BitArray(5)
+    bits.set(1, True)
+    bits.set(4, True)
+    out = roundtrip(m.VoteSetBitsMessage(4, 1, 2, _bid(), bits))
+    assert out.votes.get(4) and not out.votes.get(0)
+
+
+def test_new_valid_block():
+    bits = BitArray(3)
+    bits.set(0, True)
+    out = roundtrip(m.NewValidBlockMessage(6, 0, PartSetHeader(3, b"\x07" * 32),
+                                           bits, True))
+    assert out.is_commit and out.block_parts_header.total == 3

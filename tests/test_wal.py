@@ -1,0 +1,74 @@
+"""Consensus WAL: framing, round trips, end-height search, torn-tail
+repair (reference: consensus/wal_test.go)."""
+
+from tendermint_tpu.consensus.wal import (
+    EndHeightMessage, MsgInfo, RoundStateMessage, TimeoutInfo, WAL,
+)
+
+
+def test_wal_roundtrip(tmp_path):
+    path = str(tmp_path / "wal")
+    wal = WAL(path)
+    wal.write(RoundStateMessage(1, 0, 3), time_ns=111)
+    wal.write(MsgInfo("peer-1", b"\x06votebytes"), time_ns=222)
+    wal.write(TimeoutInfo(2.5, 1, 0, 4), time_ns=333)
+    wal.write_sync(EndHeightMessage(1), time_ns=444)
+    wal.close()
+
+    msgs = WAL.decode_all(path)
+    assert len(msgs) == 4
+    assert msgs[0].msg == RoundStateMessage(1, 0, 3)
+    assert msgs[0].time_ns == 111
+    assert msgs[1].msg == MsgInfo("peer-1", b"\x06votebytes")
+    assert msgs[2].msg.height == 1 and abs(msgs[2].msg.duration_s - 2.5) < 1e-9
+    assert msgs[3].msg == EndHeightMessage(1)
+
+
+def test_wal_search_for_end_height(tmp_path):
+    path = str(tmp_path / "wal")
+    wal = WAL(path)
+    wal.write(MsgInfo("", b"h1-msg"))
+    wal.write_sync(EndHeightMessage(1))
+    wal.write(MsgInfo("", b"h2-msg-a"))
+    wal.write(MsgInfo("", b"h2-msg-b"))
+    wal.write_sync(EndHeightMessage(2))
+    wal.write(MsgInfo("", b"h3-inflight"))
+    wal.close()
+
+    tail, found = WAL(path).search_for_end_height(2)
+    assert found
+    assert [t.msg.msg_bytes for t in tail] == [b"h3-inflight"]
+
+    _, found0 = WAL(path).search_for_end_height(99)
+    assert not found0
+
+
+def test_wal_torn_tail_stops_cleanly(tmp_path):
+    path = str(tmp_path / "wal")
+    wal = WAL(path)
+    wal.write_sync(EndHeightMessage(5))
+    wal.close()
+    with open(path, "ab") as f:
+        f.write(b"\x00\x01garbage-torn-record")
+    msgs = WAL.decode_all(path)
+    assert len(msgs) == 1 and msgs[0].msg == EndHeightMessage(5)
+
+
+def test_wal_repair_truncates_tail(tmp_path):
+    path = str(tmp_path / "wal")
+    wal = WAL(path)
+    wal.write_sync(EndHeightMessage(5))
+    wal.close()
+    import os
+
+    good_size = os.path.getsize(path)
+    with open(path, "ab") as f:
+        f.write(b"\xff" * 37)
+    wal2 = WAL(path)
+    assert wal2.repair() is True
+    assert os.path.getsize(path) == good_size
+    # post-repair appends work
+    wal2.write_sync(EndHeightMessage(6))
+    wal2.close()
+    msgs = WAL.decode_all(path)
+    assert [type(m.msg) for m in msgs] == [EndHeightMessage, EndHeightMessage]
