@@ -24,6 +24,36 @@ class HandshakeError(Exception):
     pass
 
 
+class _MockReplayClient(Client):
+    """Stands in for the app when replaying a block it has already
+    committed: answers from the ABCI responses saved at apply time and
+    reports the app's own hash on Commit, so tendermint state catches
+    up without double-executing (reference replay.go:370-415)."""
+
+    def __init__(self, saved_responses: dict | None, app_hash: bytes):
+        super().__init__(name="abci.MockReplayClient")
+        self._saved = saved_responses
+        self._app_hash = app_hash
+        self._tx_i = 0
+
+    async def deliver(self, req):
+        if isinstance(req, abci_t.RequestBeginBlock):
+            return (self._saved or {}).get("begin_block") \
+                or abci_t.ResponseBeginBlock()
+        if isinstance(req, abci_t.RequestDeliverTx):
+            txs = (self._saved or {}).get("deliver_txs") or []
+            r = (txs[self._tx_i] if self._tx_i < len(txs)
+                 else abci_t.ResponseDeliverTx())
+            self._tx_i += 1
+            return r
+        if isinstance(req, abci_t.RequestEndBlock):
+            return (self._saved or {}).get("end_block") \
+                or abci_t.ResponseEndBlock()
+        if isinstance(req, abci_t.RequestCommit):
+            return abci_t.ResponseCommit(data=self._app_hash)
+        raise HandshakeError(f"mock replay client got {type(req).__name__}")
+
+
 class Handshaker:
     def __init__(self, state_store: Store, state: SmState,
                  block_store: BlockStore, genesis_doc: GenesisDoc,
@@ -118,13 +148,25 @@ class Handshaker:
             app_hash = await self._exec_block(h, app_conns)
             self.n_blocks_replayed += 1
 
-        if full_apply_last and store_height >= first:
+        if full_apply_last:
             block = self.block_store.load_block(store_height)
             if block is None:
                 raise HandshakeError(f"missing block {store_height}")
-            executor = BlockExecutor(self.state_store, app_conns.consensus,
-                                     event_bus=self.event_bus)
             prev_state = self.state_store.load() or state
+            if store_height >= first:
+                # app is also missing this block: full apply drives it
+                client = app_conns.consensus
+            else:
+                # app already committed it (crash between app Commit and
+                # state save) — bring ONLY tendermint state forward, via
+                # a mock client replaying the saved ABCI responses
+                # (reference replay.go:370-415 newMockProxyApp).
+                client = _MockReplayClient(
+                    self.state_store.load_abci_responses(store_height),
+                    app_hash,
+                )
+            executor = BlockExecutor(self.state_store, client,
+                                     event_bus=self.event_bus)
             new_state, _ = await executor.apply_block(
                 prev_state, block.block_id(), block
             )

@@ -228,14 +228,30 @@ class ConsensusState(Service):
             self.wal.write_sync(msg, _time.time_ns())
 
     async def _handle_msg(self, qm: _QueuedMsg) -> None:
+        """Validation failures on a single message are logged and
+        dropped — one byzantine peer must not halt the node (reference
+        handleMsg logs setProposal/AddProposalBlockPart errors and
+        continues). Errors inside step *transitions* still propagate:
+        those are local invariant violations (reference panics →
+        graceful halt)."""
         msg = qm.msg
         if isinstance(msg, m.ProposalMessage):
-            self._set_proposal(msg.proposal)
+            try:
+                self._set_proposal(msg.proposal)
+            except Exception as e:
+                self.logger.warning("rejecting proposal from %r: %s",
+                                    qm.peer_id, e)
+                return
             # parts may have completed before the proposal arrived
             if self.rs.proposal_complete():
                 await self._proposal_completed()
         elif isinstance(msg, m.BlockPartMessage):
-            added = self._add_proposal_block_part(msg)
+            try:
+                added = self._add_proposal_block_part(msg)
+            except Exception as e:
+                self.logger.warning("rejecting block part from %r: %s",
+                                    qm.peer_id, e)
+                return
             if added and self.rs.proposal_complete():
                 await self._proposal_completed()
         elif isinstance(msg, m.VoteMessage):
@@ -647,7 +663,7 @@ class ConsensusState(Service):
                 )
                 self.evpool.add_evidence_from_consensus(ev)
             return False
-        except VoteSetError as e:
+        except (VoteSetError, ValueError) as e:
             self.logger.debug("vote rejected: %s", e)
             return False
 

@@ -1,0 +1,303 @@
+"""Concurrent-list mempool (reference: mempool/clist_mempool.go:37).
+
+Validated transactions sit in FIFO order on a CList that per-peer
+broadcast routines iterate with blocking waits; a bounded first-seen
+cache short-circuits duplicate CheckTx work; after every commit the
+pool drops committed txs and re-runs CheckTx on the remainder
+("recheck", reference :577,639). An optional write-ahead log persists
+accepted txs so a restarted node can refill its pool (reference :140).
+
+Differences from the reference are deliberate asyncio redesigns:
+CheckTx is awaited through the pipelined ABCI client rather than a
+callback chain, and the commit-window lock is an event the executor
+toggles around ApplyBlock's Commit (reference updateMtx).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+from ..abci import types as abci
+from ..config import MempoolConfig
+from ..libs.clist import CList
+from ..types.tx import tx_hash
+from . import Mempool
+
+
+class TxInMempoolError(Exception):
+    pass
+
+
+class MempoolFullError(Exception):
+    def __init__(self, n_txs: int, tx_bytes: int):
+        super().__init__(f"mempool full: {n_txs} txs, {tx_bytes} bytes")
+
+
+class TxTooLargeError(Exception):
+    pass
+
+
+@dataclass
+class MempoolTx:
+    """reference: mempoolTx (clist_mempool.go:765)."""
+
+    tx: bytes
+    height: int              # height when validated
+    gas_wanted: int
+    senders: set[str] = field(default_factory=set)  # peers that sent it
+
+
+class TxCache:
+    """Bounded FIFO-eviction cache of seen tx hashes
+    (reference: mapTxCache, clist_mempool.go:697)."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self._m: OrderedDict[bytes, None] = OrderedDict()
+
+    def push(self, key: bytes) -> bool:
+        """Returns False if already present."""
+        if key in self._m:
+            self._m.move_to_end(key)
+            return False
+        self._m[key] = None
+        while len(self._m) > self.size:
+            self._m.popitem(last=False)
+        return True
+
+    def remove(self, key: bytes) -> None:
+        self._m.pop(key, None)
+
+    def reset(self) -> None:
+        self._m.clear()
+
+
+
+
+
+class CListMempool(Mempool):
+    def __init__(self, config: MempoolConfig, client, height: int = 0,
+                 precheck=None, postcheck=None, logger=None):
+        self.config = config
+        self.client = client          # ABCI client (mempool connection)
+        self.height = height
+        self.precheck = precheck
+        self.postcheck = postcheck
+        self.txs = CList()
+        self.tx_map: dict[bytes, object] = {}   # hash -> CElement
+        self.cache = TxCache(config.cache_size)
+        self._tx_bytes = 0
+        self._unlocked = asyncio.Event()
+        self._unlocked.set()
+        self._recheck_cursor = None
+        self._wal = None
+        self._notify_available: asyncio.Event = asyncio.Event()
+        if config.wal_dir:
+            self._open_wal(config.wal_dir)
+
+    # --- sizes ---------------------------------------------------------------
+
+    def size(self) -> int:
+        return len(self.txs)
+
+    def tx_bytes(self) -> int:
+        return self._tx_bytes
+
+    # --- commit-window lock --------------------------------------------------
+
+    def lock(self) -> None:
+        self._unlocked.clear()
+
+    def unlock(self) -> None:
+        self._unlocked.set()
+
+    async def flush_app_conn(self) -> None:
+        await self.client.flush()
+
+    # --- WAL -----------------------------------------------------------------
+
+    def _open_wal(self, wal_dir: str) -> None:
+        os.makedirs(wal_dir, exist_ok=True)
+        self._wal_path = os.path.join(wal_dir, "mempool.wal")
+        self._wal = open(self._wal_path, "ab")
+
+    def wal_pending_txs(self) -> list[bytes]:
+        """Txs recorded in the WAL, for refill on restart."""
+        if not self.config.wal_dir:
+            return []
+        path = os.path.join(self.config.wal_dir, "mempool.wal")
+        if not os.path.exists(path):
+            return []
+        out = []
+        with open(path, "rb") as f:
+            data = f.read()
+        i = 0
+        while i + 4 <= len(data):
+            ln = int.from_bytes(data[i:i + 4], "big")
+            if i + 4 + ln > len(data):
+                break  # torn tail
+            out.append(data[i + 4:i + 4 + ln])
+            i += 4 + ln
+        return out
+
+    def close_wal(self) -> None:
+        if self._wal:
+            self._wal.close()
+            self._wal = None
+
+    # --- CheckTx admission ---------------------------------------------------
+
+    async def check_tx(self, tx: bytes, tx_info: dict | None = None):
+        """Admit a tx: guards → cache → ABCI CheckTx → insert.
+        reference: CheckTx (clist_mempool.go:235) + resCbFirstTime (:367).
+        """
+        await self._unlocked.wait()
+
+        if len(tx) > self.config.max_tx_bytes:
+            raise TxTooLargeError(
+                f"tx {len(tx)}B > max {self.config.max_tx_bytes}B")
+        if self.precheck is not None:
+            err = self.precheck(tx)
+            if err is not None:
+                raise ValueError(f"precheck: {err}")
+        if (self.size() >= self.config.size
+                or self._tx_bytes + len(tx) > self.config.max_txs_bytes):
+            raise MempoolFullError(self.size(), self._tx_bytes)
+
+        key = tx_hash(tx)
+        if not self.cache.push(key):
+            # Record the extra sender for dedup in broadcast
+            # (reference clist_mempool.go:257-266).
+            e = self.tx_map.get(key)
+            if e is not None and tx_info and tx_info.get("sender"):
+                e.value.senders.add(tx_info["sender"])
+            raise TxInMempoolError("tx already in cache")
+
+        res = await self.client.check_tx(abci.RequestCheckTx(tx=tx))
+
+        if self.postcheck is not None and res.code == abci.CODE_TYPE_OK:
+            err = self.postcheck(tx, res)
+            if err is not None:
+                res = abci.ResponseCheckTx(code=1, log=f"postcheck: {err}",
+                                           gas_wanted=res.gas_wanted)
+        if res.code != abci.CODE_TYPE_OK:
+            if not self.config.keep_invalid_txs_in_cache:
+                self.cache.remove(key)
+            return res
+
+        # Re-check capacity: it may have filled while awaiting the app.
+        if (self.size() >= self.config.size
+                or self._tx_bytes + len(tx) > self.config.max_txs_bytes):
+            self.cache.remove(key)
+            raise MempoolFullError(self.size(), self._tx_bytes)
+        if key in self.tx_map:
+            return res  # raced duplicate
+
+        mtx = MempoolTx(tx=tx, height=self.height,
+                        gas_wanted=res.gas_wanted)
+        if tx_info and tx_info.get("sender"):
+            mtx.senders.add(tx_info["sender"])
+        e = self.txs.push_back(mtx)
+        self.tx_map[key] = e
+        self._tx_bytes += len(tx)
+        if self._wal:
+            self._wal.write(len(tx).to_bytes(4, "big") + tx)
+            self._wal.flush()
+        self._notify_available.set()
+        return res
+
+    def txs_available(self) -> asyncio.Event:
+        """Event set when txs enter an empty pool (reference:
+        TxsAvailable channel, consensus waits on it before proposing)."""
+        return self._notify_available
+
+    # --- reaping -------------------------------------------------------------
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> list[bytes]:
+        """reference: ReapMaxBytesMaxGas (clist_mempool.go:526)."""
+        out, total_bytes, total_gas = [], 0, 0
+        for mtx in self.txs:
+            if max_bytes > -1 and total_bytes + len(mtx.tx) > max_bytes:
+                break
+            if max_gas > -1 and total_gas + mtx.gas_wanted > max_gas:
+                break
+            total_bytes += len(mtx.tx)
+            total_gas += mtx.gas_wanted
+            out.append(mtx.tx)
+        return out
+
+    def reap_max_txs(self, n: int) -> list[bytes]:
+        out = []
+        for mtx in self.txs:
+            if 0 <= n <= len(out):
+                break
+            out.append(mtx.tx)
+        return out
+
+    # --- post-commit update --------------------------------------------------
+
+    async def update(self, height: int, txs: list[bytes], results: list,
+                     precheck=None, postcheck=None) -> None:
+        """Drop committed txs and recheck the rest.
+        reference: Update (clist_mempool.go:577). Caller holds lock()."""
+        self.height = height
+        if precheck is not None:
+            self.precheck = precheck
+        if postcheck is not None:
+            self.postcheck = postcheck
+
+        for tx, res in zip(txs, results):
+            key = tx_hash(tx)
+            if getattr(res, "code", 0) == abci.CODE_TYPE_OK:
+                # Committed-valid stays in cache to reject replays.
+                self.cache.push(key)
+            elif not self.config.keep_invalid_txs_in_cache:
+                self.cache.remove(key)
+            e = self.tx_map.pop(key, None)
+            if e is not None:
+                self.txs.remove(e)
+                self._tx_bytes -= len(tx)
+
+        if self.config.recheck and self.size() > 0:
+            await self._recheck_txs()
+        if self.size() == 0:
+            self._notify_available.clear()
+        else:
+            self._notify_available.set()
+
+    async def _recheck_txs(self) -> None:
+        """Re-run CheckTx on every remaining tx; drop the now-invalid
+        (reference: recheckTxs :639 + resCbRecheck :430)."""
+        snapshot = list(self.txs)
+        tasks = [self.client.submit(
+            abci.RequestCheckTx(tx=mtx.tx, type=abci.CheckTxType.RECHECK))
+            for mtx in snapshot]
+        results = await asyncio.gather(*tasks)
+        stale = []
+        for mtx, res in zip(snapshot, results):
+            ok = res.code == abci.CODE_TYPE_OK
+            if ok and self.postcheck is not None:
+                ok = self.postcheck(mtx.tx, res) is None
+            if not ok:
+                stale.append(mtx.tx)
+        for tx in stale:
+            key = tx_hash(tx)
+            e = self.tx_map.pop(key, None)
+            if e is not None:
+                self.txs.remove(e)
+                self._tx_bytes -= len(tx)
+            if not self.config.keep_invalid_txs_in_cache:
+                self.cache.remove(key)
+
+    async def flush(self) -> None:
+        """Drop everything (RPC unsafe_flush_mempool)."""
+        for mtx in list(self.txs):
+            e = self.tx_map.pop(tx_hash(mtx.tx), None)
+            if e is not None:
+                self.txs.remove(e)
+        self._tx_bytes = 0
+        self.cache.reset()
+        self._notify_available.clear()

@@ -200,3 +200,50 @@ def test_handshake_replays_into_fresh_app(tmp_path):
         await conns.stop()
 
     asyncio.run(go())
+
+
+def test_handshake_app_ahead_of_state(tmp_path):
+    """Crash between app Commit and state save: app_height ==
+    store_height == state_height+1. Handshake must bring tendermint
+    state forward WITHOUT re-executing the block on the app
+    (replay.go:370-415 mock-app path)."""
+
+    async def go():
+        gdoc, pvs = make_genesis(1)
+        node = Node(gdoc, pvs[0], tmp_path)
+        await node.start()
+        await node.cs.wait_for_height(3, timeout=30)
+        await node.stop()
+
+        # simulate the crash window: roll tendermint state back one
+        # height while keeping block store + app at H
+        state_store = Store(node.state_db)
+        block_store = BlockStore(node.block_db)
+        state = state_store.load()
+        H = block_store.height
+        assert state.last_block_height == H
+        prev = state_store.load()  # rebuild state as-of H-1
+        block_h = block_store.load_block(H)
+        prev.last_block_height = H - 1
+        prev.last_block_id = block_h.header.last_block_id
+        prev.last_block_time = block_store.load_block(H - 1).header.time
+        prev.app_hash = block_h.header.app_hash  # app hash after H-1
+        prev.last_results_hash = block_h.header.last_results_hash
+        state_store.save(prev)
+
+        app2 = PersistentKVStoreApp(node.app_db)  # still at height H
+        assert app2.height == H
+        deliver_count = {"n": 0}
+        orig = app2.deliver_tx
+        app2.deliver_tx = lambda req: (deliver_count.__setitem__("n", deliver_count["n"] + 1), orig(req))[1]
+        conns = AppConns(ClientCreator(app=app2))
+        await conns.start()
+        state2 = await handshake_and_load_state(
+            None, state_store, block_store, gdoc, conns,
+        )
+        assert state2.last_block_height == H
+        assert state2.app_hash == app2.app_hash
+        assert deliver_count["n"] == 0  # app was NOT re-driven
+        await conns.stop()
+
+    asyncio.run(go())

@@ -1,0 +1,204 @@
+"""CList mempool tests (analogue of reference mempool/clist_mempool_test.go)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client import LocalClient
+from tendermint_tpu.abci.kvstore import KVStoreApp
+from tendermint_tpu.libs.clist import CList
+from tendermint_tpu.mempool import TxPostCheck, TxPreCheck
+from tendermint_tpu.mempool.clist_mempool import (
+    CListMempool, MempoolConfig, MempoolFullError, TxInMempoolError,
+    TxTooLargeError,
+)
+
+
+class CounterApp(abci.Application):
+    """Admits only monotonically increasing 8-byte counters — gives the
+    recheck path something to invalidate (reference counter app)."""
+
+    def __init__(self):
+        self.committed = 0
+
+    def check_tx(self, req):
+        v = int.from_bytes(req.tx, "big")
+        if v < self.committed:
+            return abci.ResponseCheckTx(code=2, log="stale counter")
+        return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK, gas_wanted=1)
+
+
+def make_pool(app=None, **cfg):
+    app = app or KVStoreApp()
+    client = LocalClient(app)
+    pool = CListMempool(MempoolConfig(**cfg), client)
+    return pool, app
+
+
+def tx(i: int) -> bytes:
+    return b"tx-%08d" % i
+
+
+def test_clist_basics():
+    cl = CList()
+    e1 = cl.push_back(1)
+    e2 = cl.push_back(2)
+    cl.push_back(3)
+    assert list(cl) == [1, 2, 3]
+    cl.remove(e2)
+    assert list(cl) == [1, 3]
+    assert len(cl) == 2
+    # removed element's next pointer still walks forward
+    assert e2.next().value == 3
+    cl.remove(e1)
+    assert cl.front().value == 3
+
+
+def test_clist_waitable_iteration():
+    async def run():
+        cl = CList()
+        seen = []
+
+        async def reader():
+            e = await cl.front_wait()
+            while len(seen) < 3:
+                seen.append(e.value)
+                if len(seen) == 3:
+                    break
+                nxt = await e.next_wait()
+                e = nxt if nxt is not None else await cl.front_wait()
+
+        t = asyncio.get_event_loop().create_task(reader())
+        await asyncio.sleep(0)
+        cl.push_back("a")
+        await asyncio.sleep(0)
+        cl.push_back("b")
+        cl.push_back("c")
+        await asyncio.wait_for(t, 2)
+        assert seen == ["a", "b", "c"]
+
+    asyncio.get_event_loop().run_until_complete(run())
+
+
+def run(coro):
+    return asyncio.get_event_loop().run_until_complete(coro)
+
+
+def test_check_tx_admit_and_reap():
+    pool, _ = make_pool()
+    for i in range(10):
+        res = run(pool.check_tx(tx(i)))
+        assert res.code == abci.CODE_TYPE_OK
+    assert pool.size() == 10
+    assert pool.tx_bytes() == 10 * len(tx(0))
+    # FIFO order preserved
+    assert pool.reap_max_txs(-1) == [tx(i) for i in range(10)]
+    # byte cap: each tx is 11 bytes
+    assert pool.reap_max_bytes_max_gas(33, -1) == [tx(0), tx(1), tx(2)]
+    # gas cap: kvstore wants 1 gas per tx
+    assert pool.reap_max_bytes_max_gas(-1, 4) == [tx(i) for i in range(4)]
+
+
+def test_duplicate_rejected_by_cache():
+    pool, _ = make_pool()
+    run(pool.check_tx(tx(1)))
+    with pytest.raises(TxInMempoolError):
+        run(pool.check_tx(tx(1)))
+    assert pool.size() == 1
+
+
+def test_too_large_and_full():
+    pool, _ = make_pool(max_tx_bytes=8)
+    with pytest.raises(TxTooLargeError):
+        run(pool.check_tx(b"x" * 9))
+    pool2, _ = make_pool(size=2)
+    run(pool2.check_tx(tx(1)))
+    run(pool2.check_tx(tx(2)))
+    with pytest.raises(MempoolFullError):
+        run(pool2.check_tx(tx(3)))
+
+
+def test_precheck_postcheck():
+    pool, _ = make_pool()
+    pool.precheck = TxPreCheck(max_tx_bytes=8)
+    with pytest.raises(ValueError):
+        run(pool.check_tx(b"x" * 9))
+    pool.precheck = None
+    pool.postcheck = TxPostCheck(max_gas=0)  # kvstore wants 1
+    res = run(pool.check_tx(tx(1)))
+    assert res.code != abci.CODE_TYPE_OK
+    assert pool.size() == 0
+    # rejected tx was evicted from cache → may be resubmitted
+    pool.postcheck = None
+    res = run(pool.check_tx(tx(1)))
+    assert res.code == abci.CODE_TYPE_OK
+
+
+def test_update_removes_committed_and_blocks_replay():
+    pool, _ = make_pool()
+    for i in range(5):
+        run(pool.check_tx(tx(i)))
+    committed = [tx(0), tx(2)]
+    results = [abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK)] * 2
+    pool.lock()
+    run(pool.update(2, committed, results))
+    pool.unlock()
+    assert pool.reap_max_txs(-1) == [tx(1), tx(3), tx(4)]
+    # committed txs stay cached → replay rejected
+    with pytest.raises(TxInMempoolError):
+        run(pool.check_tx(tx(0)))
+
+
+def test_recheck_drops_stale():
+    app = CounterApp()
+    pool, _ = make_pool(app)
+    for i in range(5):
+        run(pool.check_tx((i).to_bytes(8, "big")))
+    assert pool.size() == 5
+    # commit counters 0..2 → txs 0,1,2 leave via update; recheck must
+    # also drop any remaining below the new floor (none here), keep 3,4
+    app.committed = 3
+    committed = [(i).to_bytes(8, "big") for i in range(3)]
+    results = [abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK)] * 3
+    run(pool.update(2, committed, results))
+    assert pool.reap_max_txs(-1) == [(3).to_bytes(8, "big"), (4).to_bytes(8, "big")]
+    # now the app's floor moves past them → recheck clears the pool
+    app.committed = 10
+    run(pool.update(3, [], []))
+    assert pool.size() == 0
+
+
+def test_lock_blocks_check_tx():
+    async def scenario():
+        pool, _ = make_pool()
+        pool.lock()
+        task = asyncio.get_event_loop().create_task(pool.check_tx(tx(1)))
+        await asyncio.sleep(0.01)
+        assert not task.done()
+        assert pool.size() == 0
+        pool.unlock()
+        await asyncio.wait_for(task, 2)
+        assert pool.size() == 1
+
+    run(scenario())
+
+
+def test_wal_refill(tmp_path):
+    wal_dir = str(tmp_path / "mempool")
+    pool, _ = make_pool(wal_dir=wal_dir)
+    for i in range(3):
+        run(pool.check_tx(tx(i)))
+    pool.close_wal()
+    pool2, _ = make_pool(wal_dir=wal_dir)
+    assert pool2.wal_pending_txs() == [tx(0), tx(1), tx(2)]
+
+
+def test_txs_available_event():
+    pool, _ = make_pool()
+    ev = pool.txs_available()
+    assert not ev.is_set()
+    run(pool.check_tx(tx(1)))
+    assert ev.is_set()
+    run(pool.update(2, [tx(1)], [abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK)]))
+    assert not ev.is_set()
