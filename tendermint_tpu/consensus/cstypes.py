@@ -107,7 +107,7 @@ class HeightVoteSet:
         round+1 are only admitted once per peer (catchup; DoS bound,
         reference height_vote_set.go AddVote)."""
         if not VoteType.is_valid(int(vote.type)):
-            raise ValueError("invalid vote type")
+            raise VoteSetError("invalid vote type")
         vs = self._get(vote.round, vote.type)
         if vs is None:
             rounds = self._peer_catchup_rounds.setdefault(peer_id, [])

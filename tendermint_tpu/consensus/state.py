@@ -663,7 +663,7 @@ class ConsensusState(Service):
                 )
                 self.evpool.add_evidence_from_consensus(ev)
             return False
-        except (VoteSetError, ValueError) as e:
+        except VoteSetError as e:
             self.logger.debug("vote rejected: %s", e)
             return False
 

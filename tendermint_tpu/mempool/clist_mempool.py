@@ -92,7 +92,9 @@ class CListMempool(Mempool):
         self._tx_bytes = 0
         self._unlocked = asyncio.Event()
         self._unlocked.set()
-        self._recheck_cursor = None
+        # txs committed while a CheckTx was awaiting the app — checked
+        # on resume so an in-flight tx can't re-enter after its block
+        self._recently_committed: OrderedDict[bytes, None] = OrderedDict()
         self._wal = None
         self._notify_available: asyncio.Event = asyncio.Event()
         if config.wal_dir:
@@ -143,6 +145,19 @@ class CListMempool(Mempool):
             i += 4 + ln
         return out
 
+    def _rewrite_wal(self) -> None:
+        """Compact the WAL to the current pending set (runs per block,
+        not per tx — so the file is the pending set, not a history)."""
+        if not self._wal:
+            return
+        tmp = self._wal_path + ".tmp"
+        with open(tmp, "wb") as f:
+            for mtx in self.txs:
+                f.write(len(mtx.tx).to_bytes(4, "big") + mtx.tx)
+        self._wal.close()
+        os.replace(tmp, self._wal_path)
+        self._wal = open(self._wal_path, "ab")
+
     def close_wal(self) -> None:
         if self._wal:
             self._wal.close()
@@ -178,6 +193,13 @@ class CListMempool(Mempool):
 
         res = await self.client.check_tx(abci.RequestCheckTx(tx=tx))
 
+        # The commit window may have opened while we awaited the app:
+        # wait it out, and drop the tx if its block just committed
+        # (reference holds updateMtx.RLock across all of CheckTx).
+        await self._unlocked.wait()
+        if key in self._recently_committed:
+            return res
+
         if self.postcheck is not None and res.code == abci.CODE_TYPE_OK:
             err = self.postcheck(tx, res)
             if err is not None:
@@ -204,8 +226,10 @@ class CListMempool(Mempool):
         self.tx_map[key] = e
         self._tx_bytes += len(tx)
         if self._wal:
+            # buffered; flushed per block in _rewrite_wal (a hard crash
+            # loses at most the buffer — the WAL is best-effort refill,
+            # not consensus-critical, matching the reference)
             self._wal.write(len(tx).to_bytes(4, "big") + tx)
-            self._wal.flush()
         self._notify_available.set()
         return res
 
@@ -251,6 +275,9 @@ class CListMempool(Mempool):
 
         for tx, res in zip(txs, results):
             key = tx_hash(tx)
+            self._recently_committed[key] = None
+            while len(self._recently_committed) > self.config.cache_size:
+                self._recently_committed.popitem(last=False)
             if getattr(res, "code", 0) == abci.CODE_TYPE_OK:
                 # Committed-valid stays in cache to reject replays.
                 self.cache.push(key)
@@ -263,6 +290,7 @@ class CListMempool(Mempool):
 
         if self.config.recheck and self.size() > 0:
             await self._recheck_txs()
+        self._rewrite_wal()
         if self.size() == 0:
             self._notify_available.clear()
         else:
@@ -301,3 +329,4 @@ class CListMempool(Mempool):
         self._tx_bytes = 0
         self.cache.reset()
         self._notify_available.clear()
+        self._rewrite_wal()

@@ -1,0 +1,257 @@
+"""Multiplexed connection (reference: p2p/conn/connection.go:78).
+
+One secret connection carries N logical channels. Messages are cut
+into packets (channel id, eof flag, fragment) so a large block part
+can't head-of-line-block a vote; the send loop picks the channel with
+the lowest sent-bytes/priority ratio (reference sendPacketMsg's
+least-ratio selection). Ping/pong keepalive with a pong timeout, and
+token-bucket send/recv rate limiting (reference: flowrate.Monitor,
+default 500 KB/s each way).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+
+from ...libs.service import Service
+from .secret_connection import DATA_MAX, SEALED_SIZE, SecretConnection
+
+# packet types
+_PKT_PING = 0x01
+_PKT_PONG = 0x02
+_PKT_MSG = 0x03
+
+MAX_PACKET_PAYLOAD = DATA_MAX - 8  # header: type+chan+eof+len(2) < 8
+
+
+@dataclass
+class ChannelDescriptor:
+    id: int
+    priority: int = 1
+    send_queue_capacity: int = 64
+    recv_message_capacity: int = 22020096  # ~21MB, reference consensus default
+    name: str = ""
+
+
+@dataclass
+class MConnConfig:
+    """reference: MConnConfig (connection.go:122)."""
+
+    send_rate: int = 5_000_000       # bytes/s (reference default 500KB/s;
+    recv_rate: int = 5_000_000       # raised: TPU-host NICs are not 2014's)
+    flush_throttle_ms: int = 10
+    ping_interval_s: float = 10.0
+    pong_timeout_s: float = 45.0
+    max_packet_payload: int = MAX_PACKET_PAYLOAD
+
+
+@dataclass
+class ChannelStatus:
+    id: int
+    send_queue_size: int
+    priority: int
+    recently_sent: int
+
+
+class _Channel:
+    def __init__(self, desc: ChannelDescriptor):
+        self.desc = desc
+        self.queue: asyncio.Queue[bytes] = asyncio.Queue(
+            desc.send_queue_capacity)
+        self.sending: bytes | None = None   # message being packetized
+        self.sent_pos = 0
+        self.recently_sent = 0
+        self.recv_buf = bytearray()
+
+    def load_next(self) -> bool:
+        if self.sending is None and not self.queue.empty():
+            self.sending = self.queue.get_nowait()
+            self.sent_pos = 0
+        return self.sending is not None
+
+    def next_packet(self, max_payload: int) -> tuple[bytes, bool]:
+        assert self.sending is not None
+        frag = self.sending[self.sent_pos:self.sent_pos + max_payload]
+        self.sent_pos += len(frag)
+        eof = self.sent_pos >= len(self.sending)
+        if eof:
+            self.sending = None
+            self.sent_pos = 0
+        return frag, eof
+
+
+class _TokenBucket:
+    def __init__(self, rate: int):
+        self.rate = rate
+        self.tokens = float(rate)
+        self.last = time.monotonic()
+
+    async def consume(self, n: int) -> None:
+        while True:
+            now = time.monotonic()
+            self.tokens = min(self.rate, self.tokens + (now - self.last) * self.rate)
+            self.last = now
+            if self.tokens >= n:
+                self.tokens -= n
+                return
+            await asyncio.sleep((n - self.tokens) / self.rate)
+
+
+class MConnection(Service):
+    """on_receive(chan_id, msg_bytes) runs on the recv loop; on_error(exc)
+    fires once when either loop dies (the Switch stops the peer)."""
+
+    def __init__(self, conn: SecretConnection,
+                 channels: list[ChannelDescriptor],
+                 on_receive, on_error=None, config: MConnConfig | None = None):
+        super().__init__(name="MConnection")
+        self.conn = conn
+        self.config = config or MConnConfig()
+        self.channels = {d.id: _Channel(d) for d in channels}
+        self.on_receive = on_receive
+        self.on_error = on_error
+        self._send_signal = asyncio.Event()
+        self._pong_pending = asyncio.Event()
+        self._send_bucket = _TokenBucket(self.config.send_rate)
+        self._recv_bucket = _TokenBucket(self.config.recv_rate)
+        self._errored = False
+
+    async def on_start(self) -> None:
+        self.spawn(self._send_routine(), "mconn-send")
+        self.spawn(self._recv_routine(), "mconn-recv")
+        self.spawn(self._ping_routine(), "mconn-ping")
+
+    async def on_stop(self) -> None:
+        self.conn.close()
+
+    def _error(self, exc: Exception) -> None:
+        if self._errored:
+            return
+        self._errored = True
+        if self.on_error is not None:
+            self.on_error(exc)
+
+    # -- sending --
+
+    async def send(self, chan_id: int, msg: bytes) -> bool:
+        """Queue a message; awaits if the channel queue is full
+        (reference Peer.Send blocking semantics)."""
+        ch = self.channels.get(chan_id)
+        if ch is None or not self.is_running:
+            return False
+        await ch.queue.put(msg)
+        self._send_signal.set()
+        return True
+
+    def try_send(self, chan_id: int, msg: bytes) -> bool:
+        """Non-blocking send; False if the queue is full."""
+        ch = self.channels.get(chan_id)
+        if ch is None or not self.is_running:
+            return False
+        try:
+            ch.queue.put_nowait(msg)
+        except asyncio.QueueFull:
+            return False
+        self._send_signal.set()
+        return True
+
+    def _pick_channel(self) -> _Channel | None:
+        """Least recently_sent/priority ratio among channels with data
+        (reference: sendPacketMsg)."""
+        best, best_ratio = None, None
+        for ch in self.channels.values():
+            if not ch.load_next():
+                continue
+            ratio = ch.recently_sent / ch.desc.priority
+            if best_ratio is None or ratio < best_ratio:
+                best, best_ratio = ch, ratio
+        return best
+
+    async def _send_routine(self) -> None:
+        try:
+            while True:
+                ch = self._pick_channel()
+                if ch is None:
+                    self._send_signal.clear()
+                    # decay recently_sent while idle (reference: 2x/s)
+                    for c in self.channels.values():
+                        c.recently_sent = int(c.recently_sent * 0.8)
+                    await self._send_signal.wait()
+                    continue
+                frag, eof = ch.next_packet(self.config.max_packet_payload)
+                pkt = bytes([_PKT_MSG, ch.desc.id, 1 if eof else 0]) + \
+                    len(frag).to_bytes(2, "big") + frag
+                await self._send_bucket.consume(len(pkt))
+                self.conn.write_frame(pkt)
+                ch.recently_sent += len(pkt)
+                await self.conn.drain()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._error(e)
+
+    # -- receiving --
+
+    async def _recv_routine(self) -> None:
+        try:
+            while True:
+                pkt = await self.conn.read_frame()
+                # charge wire bytes (sealed frame), not payload — else
+                # tiny-payload frames bypass the limiter entirely
+                await self._recv_bucket.consume(SEALED_SIZE)
+                if not pkt:
+                    continue
+                t = pkt[0]
+                if t == _PKT_PING:
+                    self.conn.write_frame(bytes([_PKT_PONG]))
+                    await self.conn.drain()
+                elif t == _PKT_PONG:
+                    self._pong_pending.set()
+                elif t == _PKT_MSG:
+                    chan_id, eof = pkt[1], pkt[2]
+                    ln = int.from_bytes(pkt[3:5], "big")
+                    ch = self.channels.get(chan_id)
+                    if ch is None:
+                        raise ValueError(f"unknown channel {chan_id:#x}")
+                    ch.recv_buf += pkt[5:5 + ln]
+                    if len(ch.recv_buf) > ch.desc.recv_message_capacity:
+                        raise ValueError(
+                            f"recv msg exceeds capacity on {chan_id:#x}")
+                    if eof:
+                        msg = bytes(ch.recv_buf)
+                        ch.recv_buf = bytearray()
+                        res = self.on_receive(chan_id, msg)
+                        if asyncio.iscoroutine(res):
+                            await res
+                else:
+                    raise ValueError(f"unknown packet type {t}")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._error(e)
+
+    async def _ping_routine(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.config.ping_interval_s)
+                self._pong_pending.clear()
+                self.conn.write_frame(bytes([_PKT_PING]))
+                await self.conn.drain()
+                try:
+                    await asyncio.wait_for(self._pong_pending.wait(),
+                                           self.config.pong_timeout_s)
+                except asyncio.TimeoutError:
+                    raise TimeoutError("pong timeout") from None
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._error(e)
+
+    def status(self) -> list[ChannelStatus]:
+        return [
+            ChannelStatus(ch.desc.id, ch.queue.qsize(), ch.desc.priority,
+                          ch.recently_sent)
+            for ch in self.channels.values()
+        ]

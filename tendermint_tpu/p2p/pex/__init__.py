@@ -1,0 +1,4 @@
+from .addrbook import AddrBook
+from .reactor import PEXReactor
+
+__all__ = ["AddrBook", "PEXReactor"]

@@ -1,0 +1,145 @@
+"""PEX (peer exchange) reactor on channel 0x00 (reference:
+p2p/pex/pex_reactor.go:24).
+
+Outbound-hungry nodes ask peers for addresses; peers answer with a
+random book selection (rate-limited per peer). ensure_peers dials from
+the book until max_outbound is met. Seed mode: accept, share
+addresses, hang up (pex_reactor.go seed logic).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+from ..conn.connection import ChannelDescriptor
+from ..switch import Reactor
+from .addrbook import AddrBook
+
+PEX_CHANNEL = 0x00
+
+_MSG_REQUEST = "pex_request"
+_MSG_ADDRS = "pex_addrs"
+
+_REQUEST_INTERVAL = 60.0     # min seconds between requests from a peer
+_ENSURE_PERIOD = 30.0
+
+
+class PEXReactor(Reactor):
+    def __init__(self, book: AddrBook, seed_mode: bool = False,
+                 seeds: list[str] | None = None,
+                 ensure_period: float = _ENSURE_PERIOD):
+        super().__init__("pex")
+        self.book = book
+        self.seed_mode = seed_mode
+        self.seeds = seeds or []
+        self.ensure_period = ensure_period
+        self._last_request_from: dict[str, float] = {}
+        self._requested: set[str] = set()
+        self._task = None
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [ChannelDescriptor(id=PEX_CHANNEL, priority=1,
+                                  send_queue_capacity=10, name="pex")]
+
+    async def start(self) -> None:
+        self._task = asyncio.get_event_loop().create_task(
+            self._ensure_peers_routine())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+        self.book.save()
+
+    def init_peer(self, peer) -> None:
+        pass
+
+    async def add_peer(self, peer) -> None:
+        if peer.outbound:
+            # a dial succeeded: vet the address
+            if peer.socket_addr:
+                addr = f"{peer.id}@{peer.socket_addr}"
+                self.book.add_address(addr, src=peer.id)
+            self.book.mark_good(peer.id)
+        elif self._needs_more_peers():
+            await self._request_addrs(peer)
+
+    async def remove_peer(self, peer, reason) -> None:
+        self._requested.discard(peer.id)
+        self._last_request_from.pop(peer.id, None)
+
+    async def receive(self, chan_id: int, peer, msg: bytes) -> None:
+        d = json.loads(msg)
+        t = d.get("type")
+        if t == _MSG_REQUEST:
+            now = time.monotonic()
+            last = self._last_request_from.get(peer.id, 0.0)
+            if now - last < _REQUEST_INTERVAL and not self.seed_mode:
+                raise ValueError("pex request flood")
+            self._last_request_from[peer.id] = now
+            sel = self.book.get_selection()
+            await peer.send(PEX_CHANNEL, json.dumps(
+                {"type": _MSG_ADDRS, "addrs": sel}).encode())
+            if self.seed_mode and peer.outbound is False:
+                # seeds serve addresses then disconnect
+                await asyncio.sleep(0.5)
+                await self.switch.stop_peer_gracefully(peer)
+        elif t == _MSG_ADDRS:
+            if peer.id not in self._requested:
+                raise ValueError("unsolicited pex addrs")
+            self._requested.discard(peer.id)
+            for a in d.get("addrs", [])[:100]:
+                if isinstance(a, str):
+                    self.book.add_address(a, src=peer.id)
+        else:
+            raise ValueError(f"unknown pex msg {t!r}")
+
+    def _needs_more_peers(self) -> bool:
+        sw = self.switch
+        return sw is not None and sw._n_outbound() < sw.max_outbound
+
+    async def _request_addrs(self, peer) -> None:
+        self._requested.add(peer.id)
+        await peer.send(PEX_CHANNEL,
+                        json.dumps({"type": _MSG_REQUEST}).encode())
+
+    async def _ensure_peers_routine(self) -> None:
+        # dial seeds once if the book is empty
+        if self.book.is_empty() and self.seeds:
+            for s in self.seeds:
+                self.book.add_address(s)
+        while True:
+            try:
+                await self._ensure_peers()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+            await asyncio.sleep(self.ensure_period)
+
+    async def _ensure_peers(self) -> None:
+        sw = self.switch
+        if sw is None or not self._needs_more_peers():
+            return
+        exclude = set(sw.peers) | {
+            a.split("@", 1)[0] for a in sw.dialing if "@" in a}
+        to_dial = sw.max_outbound - sw._n_outbound()
+        for _ in range(to_dial):
+            addr = self.book.pick_address(exclude=exclude)
+            if addr is None:
+                break
+            exclude.add(addr.split("@", 1)[0])
+            nid = addr.split("@", 1)[0]
+            self.book.mark_attempt(nid)
+            try:
+                await sw.dial_peer(addr)
+            except Exception:
+                continue
+        # top up the book by asking a connected peer
+        if self.book.size() < 16 and sw.peers:
+            import random as _r
+
+            peer = _r.choice(list(sw.peers.values()))
+            if peer.id not in self._requested:
+                await self._request_addrs(peer)

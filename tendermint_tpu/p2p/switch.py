@@ -1,0 +1,278 @@
+"""Switch: peer lifecycle hub and reactor router (reference:
+p2p/switch.go:69).
+
+Reactors register channel descriptors; inbound messages route to the
+reactor owning that channel id. The switch runs the accept loop, dials
+configured/persistent peers (with exponential backoff reconnect for
+persistent ones, switch.go:393), de-duplicates by node id, and tears a
+peer down on any reactor/connection error (StopPeerForError).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+from ..libs.service import Service
+from .conn.connection import ChannelDescriptor, MConnConfig
+from .node_info import NodeInfo
+from .peer import Peer
+from .transport import Transport
+
+
+class Reactor:
+    """reference: p2p/base_reactor.go Reactor contract."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.switch: "Switch | None" = None
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return []
+
+    async def start(self) -> None:
+        pass
+
+    async def stop(self) -> None:
+        pass
+
+    def init_peer(self, peer: Peer) -> None:
+        """Set up per-peer state before the connection starts."""
+
+    async def add_peer(self, peer: Peer) -> None:
+        """Peer is connected and started; begin gossip."""
+
+    async def remove_peer(self, peer: Peer, reason) -> None:
+        pass
+
+    async def receive(self, chan_id: int, peer: Peer, msg: bytes) -> None:
+        pass
+
+
+class SwitchError(Exception):
+    pass
+
+
+class Switch(Service):
+    def __init__(self, transport: Transport, node_info_fn,
+                 mconn_config: MConnConfig | None = None,
+                 max_inbound: int = 40, max_outbound: int = 10):
+        super().__init__(name="p2p.Switch")
+        self.transport = transport
+        self.node_info_fn = node_info_fn
+        self.mconn_config = mconn_config
+        self.reactors: dict[str, Reactor] = {}
+        self.chan_to_reactor: dict[int, Reactor] = {}
+        self.channels: list[ChannelDescriptor] = []
+        self.peers: dict[str, Peer] = {}
+        self.dialing: set[str] = set()          # addrs being dialed
+        self.persistent_addrs: list[str] = []
+        self.max_inbound = max_inbound
+        self.max_outbound = max_outbound
+        self._reconnect_tasks: dict[str, asyncio.Task] = {}
+        self.addr_book = None                    # set by PEX wiring
+
+    # -- assembly --
+
+    def add_reactor(self, name: str, reactor: Reactor) -> None:
+        for d in reactor.get_channels():
+            if d.id in self.chan_to_reactor:
+                raise SwitchError(f"channel {d.id:#x} claimed twice")
+            self.chan_to_reactor[d.id] = reactor
+            self.channels.append(d)
+        reactor.switch = self
+        self.reactors[name] = reactor
+
+    def channel_ids(self) -> bytes:
+        return bytes(sorted(d.id for d in self.channels))
+
+    # -- lifecycle --
+
+    async def on_start(self) -> None:
+        for r in self.reactors.values():
+            await r.start()
+        self.spawn(self._accept_routine(), "switch-accept")
+
+    async def on_stop(self) -> None:
+        for t in self._reconnect_tasks.values():
+            t.cancel()
+        for peer in list(self.peers.values()):
+            await self._remove_peer(peer, "switch stopping")
+        for r in self.reactors.values():
+            await r.stop()
+        await self.transport.close()
+
+    # -- inbound --
+
+    async def _accept_routine(self) -> None:
+        while True:
+            conn, ni = await self.transport.accept()
+            try:
+                await self._add_peer(conn, ni, outbound=False)
+            except Exception as e:
+                self.logger.info("rejected inbound peer %s: %s",
+                                 ni.node_id[:12], e)
+                conn.close()
+
+    def _n_inbound(self) -> int:
+        return sum(1 for p in self.peers.values() if not p.outbound)
+
+    def _n_outbound(self) -> int:
+        return sum(1 for p in self.peers.values() if p.outbound)
+
+    async def _add_peer(self, conn, ni: NodeInfo, outbound: bool,
+                        persistent: bool = False, socket_addr: str = "") -> Peer:
+        if ni.node_id == self.node_info_fn().node_id:
+            raise SwitchError("connected to self")
+        if ni.node_id in self.peers:
+            raise SwitchError("duplicate peer")
+        if not outbound and self._n_inbound() >= self.max_inbound:
+            raise SwitchError("max inbound peers")
+        if outbound and not persistent and \
+                self._n_outbound() >= self.max_outbound:
+            raise SwitchError("max outbound peers")
+        peer = Peer(conn, ni, self.channels,
+                    on_receive=self._on_peer_receive,
+                    on_error=self._on_peer_error,
+                    outbound=outbound, persistent=persistent,
+                    socket_addr=socket_addr, mconn_config=self.mconn_config)
+        for r in self.reactors.values():
+            r.init_peer(peer)
+        await peer.start()
+        self.peers[ni.node_id] = peer
+        for r in self.reactors.values():
+            try:
+                await r.add_peer(peer)
+            except Exception as e:
+                await self.stop_peer_for_error(peer, e)
+                raise
+        self.logger.info("added peer %r (%d total)", peer, len(self.peers))
+        return peer
+
+    # -- outbound --
+
+    async def dial_peer(self, addr: str, persistent: bool = False) -> Peer | None:
+        """addr = 'host:port' or 'id@host:port'."""
+        expect_id, hostport = _split_addr(addr)
+        if addr in self.dialing:
+            return None
+        self.dialing.add(addr)
+        try:
+            host, port = hostport.rsplit(":", 1)
+            conn, ni = await self.transport.dial(host, int(port))
+            try:
+                if expect_id and ni.node_id != expect_id:
+                    raise SwitchError(
+                        f"dialed {addr} but peer is {ni.node_id[:12]}")
+                return await self._add_peer(conn, ni, outbound=True,
+                                            persistent=persistent,
+                                            socket_addr=hostport)
+            except Exception:
+                conn.close()
+                raise
+        finally:
+            self.dialing.discard(addr)
+
+    async def dial_peers_async(self, addrs: list[str],
+                               persistent: bool = False) -> None:
+        async def one(a):
+            try:
+                await self.dial_peer(a, persistent=persistent)
+            except Exception as e:
+                self.logger.info("dial %s failed: %s", a, e)
+                if persistent:
+                    self._schedule_reconnect(a)
+
+        await asyncio.gather(*(one(a) for a in addrs))
+
+    def add_persistent_peers(self, addrs: list[str]) -> None:
+        self.persistent_addrs.extend(addrs)
+
+    # -- teardown --
+
+    def _on_peer_error(self, peer: Peer, exc: Exception) -> None:
+        asyncio.get_event_loop().create_task(
+            self.stop_peer_for_error(peer, exc))
+
+    async def stop_peer_for_error(self, peer: Peer, reason) -> None:
+        if peer.id not in self.peers:
+            return
+        self.logger.info("stopping peer %r: %s", peer, reason)
+        await self._remove_peer(peer, reason)
+        if peer.is_persistent() and self.is_running:
+            addr = f"{peer.id}@{peer.socket_addr}" if peer.socket_addr else None
+            for a in self.persistent_addrs:
+                if _split_addr(a)[0] == peer.id:
+                    addr = a
+                    break
+            if addr:
+                self._schedule_reconnect(addr)
+
+    async def stop_peer_gracefully(self, peer: Peer) -> None:
+        await self._remove_peer(peer, "graceful stop")
+
+    async def _remove_peer(self, peer: Peer, reason) -> None:
+        self.peers.pop(peer.id, None)
+        for r in self.reactors.values():
+            try:
+                await r.remove_peer(peer, reason)
+            except Exception:
+                self.logger.exception("reactor remove_peer failed")
+        await peer.stop()
+
+    def _schedule_reconnect(self, addr: str) -> None:
+        if addr in self._reconnect_tasks and \
+                not self._reconnect_tasks[addr].done():
+            return
+
+        async def reconnect():
+            # exponential backoff (reference: reconnectToPeer switch.go:393)
+            for attempt in range(20):
+                delay = min(5 * 2 ** attempt, 300) * (0.8 + 0.4 * random.random())
+                await asyncio.sleep(delay if attempt else 1.0)
+                expect_id, _ = _split_addr(addr)
+                if expect_id and expect_id in self.peers:
+                    return
+                try:
+                    await self.dial_peer(addr, persistent=True)
+                    return
+                except Exception as e:
+                    self.logger.info("reconnect %s attempt %d failed: %s",
+                                     addr, attempt + 1, e)
+
+        self._reconnect_tasks[addr] = self.spawn(reconnect(),
+                                                 f"reconnect-{addr}")
+
+    # -- routing --
+
+    async def _on_peer_receive(self, peer: Peer, chan_id: int,
+                               msg: bytes) -> None:
+        reactor = self.chan_to_reactor.get(chan_id)
+        if reactor is None:
+            await self.stop_peer_for_error(
+                peer, f"msg on unregistered channel {chan_id:#x}")
+            return
+        try:
+            await reactor.receive(chan_id, peer, msg)
+        except Exception as e:
+            self.logger.warning("reactor %s receive error from %r: %s",
+                                reactor.name, peer, e)
+            await self.stop_peer_for_error(peer, e)
+
+    # -- broadcast --
+
+    def broadcast(self, chan_id: int, msg: bytes) -> None:
+        """Queue to every peer, non-blocking (reference switch.go:274)."""
+        for peer in list(self.peers.values()):
+            peer.try_send(chan_id, msg)
+
+    def n_peers(self) -> int:
+        return len(self.peers)
+
+
+def _split_addr(addr: str) -> tuple[str, str]:
+    """'id@host:port' → (id, 'host:port'); plain 'host:port' → ('', …)."""
+    if "@" in addr:
+        i, hp = addr.split("@", 1)
+        return i, hp
+    return "", addr

@@ -1,0 +1,301 @@
+"""P2P stack: secret connection auth/framing, MConnection multiplexing,
+Switch peer lifecycle, PEX address book (analogue of reference
+p2p/conn/secret_connection_test.go, connection_test.go, switch_test.go)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+from tendermint_tpu.p2p.conn.connection import (
+    ChannelDescriptor, MConnConfig, MConnection,
+)
+from tendermint_tpu.p2p.conn.secret_connection import (
+    AuthError, make_secret_connection,
+)
+from tendermint_tpu.p2p.key import NodeKey
+from tendermint_tpu.p2p.node_info import NodeInfo
+from tendermint_tpu.p2p.pex.addrbook import AddrBook
+from tendermint_tpu.p2p.switch import Reactor, Switch
+from tendermint_tpu.p2p.transport import HandshakeError, Transport
+
+
+def run(coro):
+    return asyncio.get_event_loop().run_until_complete(coro)
+
+
+async def tcp_pair():
+    loop = asyncio.get_event_loop()
+    fut = loop.create_future()
+
+    def factory(r, w):
+        fut.set_result((r, w))
+
+    server = await asyncio.start_server(lambda r, w: factory(r, w),
+                                        "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    c_r, c_w = await asyncio.open_connection("127.0.0.1", port)
+    s_r, s_w = await fut
+    return (c_r, c_w), (s_r, s_w), server
+
+
+def test_secret_connection_roundtrip():
+    async def go():
+        (cr, cw), (sr, sw), server = await tcp_pair()
+        k1, k2 = Ed25519PrivKey.generate(), Ed25519PrivKey.generate()
+        sc1, sc2 = await asyncio.gather(
+            make_secret_connection(cr, cw, k1),
+            make_secret_connection(sr, sw, k2),
+        )
+        # mutual authentication to node keys
+        assert sc1.remote_pubkey.bytes() == k2.pub_key().bytes()
+        assert sc2.remote_pubkey.bytes() == k1.pub_key().bytes()
+        # small message both ways
+        await sc1.write_msg(b"hello")
+        assert await sc2.read_msg() == b"hello"
+        await sc2.write_msg(b"world")
+        assert await sc1.read_msg() == b"world"
+        # multi-frame message
+        big = bytes(range(256)) * 40  # 10240 bytes > 1 frame
+        await sc1.write_msg(big)
+        assert await sc2.read_msg() == big
+        sc1.close(); sc2.close(); server.close()
+
+    run(go())
+
+
+def test_secret_connection_tamper_detected():
+    async def go():
+        (cr, cw), (sr, sw), server = await tcp_pair()
+        k1, k2 = Ed25519PrivKey.generate(), Ed25519PrivKey.generate()
+        sc1, sc2 = await asyncio.gather(
+            make_secret_connection(cr, cw, k1),
+            make_secret_connection(sr, sw, k2),
+        )
+        # flip a bit on the wire: write garbage straight to the socket
+        cw.write(b"\x00" * (1024 + 16))
+        await cw.drain()
+        with pytest.raises(Exception):
+            await sc2.read_msg()
+        sc1.close(); sc2.close(); server.close()
+
+    run(go())
+
+
+def make_mconn_pair(descs, on_recv1, on_recv2, config=None):
+    async def go():
+        (cr, cw), (sr, sw), server = await tcp_pair()
+        k1, k2 = Ed25519PrivKey.generate(), Ed25519PrivKey.generate()
+        sc1, sc2 = await asyncio.gather(
+            make_secret_connection(cr, cw, k1),
+            make_secret_connection(sr, sw, k2),
+        )
+        m1 = MConnection(sc1, descs, on_recv1, config=config)
+        m2 = MConnection(sc2, descs, on_recv2, config=config)
+        await m1.start()
+        await m2.start()
+        return m1, m2, server
+
+    return go()
+
+
+def test_mconnection_channels():
+    async def go():
+        got = asyncio.Queue()
+
+        def on_recv(ch, msg):
+            got.put_nowait((ch, msg))
+
+        descs = [ChannelDescriptor(id=0x20, priority=5),
+                 ChannelDescriptor(id=0x30, priority=1)]
+        m1, m2, server = await make_mconn_pair(descs, lambda c, m: None,
+                                               on_recv)
+        await m1.send(0x20, b"vote")
+        await m1.send(0x30, b"tx")
+        # big message crosses packet boundary (> ~1000B payload/packet)
+        big = b"B" * 5000
+        await m1.send(0x20, big)
+        msgs = {}
+        for _ in range(3):
+            ch, msg = await asyncio.wait_for(got.get(), 5)
+            msgs.setdefault(ch, []).append(msg)
+        assert b"vote" in msgs[0x20]
+        assert big in msgs[0x20]
+        assert msgs[0x30] == [b"tx"]
+        await m1.stop(); await m2.stop(); server.close()
+
+    run(go())
+
+
+def test_mconnection_unknown_channel_errors():
+    async def go():
+        errs = asyncio.Queue()
+        descs1 = [ChannelDescriptor(id=0x20), ChannelDescriptor(id=0x99)]
+        descs2 = [ChannelDescriptor(id=0x20)]
+        (cr, cw), (sr, sw), server = await tcp_pair()
+        k1, k2 = Ed25519PrivKey.generate(), Ed25519PrivKey.generate()
+        sc1, sc2 = await asyncio.gather(
+            make_secret_connection(cr, cw, k1),
+            make_secret_connection(sr, sw, k2),
+        )
+        m1 = MConnection(sc1, descs1, lambda c, m: None)
+        m2 = MConnection(sc2, descs2, lambda c, m: None,
+                         on_error=lambda e: errs.put_nowait(e))
+        await m1.start(); await m2.start()
+        await m1.send(0x99, b"mystery")
+        e = await asyncio.wait_for(errs.get(), 5)
+        assert "unknown channel" in str(e)
+        await m1.stop(); await m2.stop(); server.close()
+
+    run(go())
+
+
+class EchoReactor(Reactor):
+    """Echoes received msgs back on the same channel; records adds."""
+
+    CHAN = 0x77
+
+    def __init__(self):
+        super().__init__("echo")
+        self.added = []
+        self.received = asyncio.Queue()
+
+    def get_channels(self):
+        return [ChannelDescriptor(id=self.CHAN, priority=1)]
+
+    async def add_peer(self, peer):
+        self.added.append(peer.id)
+
+    async def receive(self, chan_id, peer, msg):
+        self.received.put_nowait((peer.id, msg))
+        if msg.startswith(b"ping:"):
+            await peer.send(self.CHAN, b"echo:" + msg[5:])
+
+
+async def make_switch(name, port=0):
+    nk = NodeKey.generate()
+    sw_holder = {}
+
+    def ni():
+        t = sw_holder["transport"]
+        addr = t.listen_addr if t._server else ""
+        return NodeInfo(node_id=nk.id, listen_addr=addr,
+                        network="p2p-test", moniker=name,
+                        channels=sw_holder["switch"].channel_ids()
+                        if "switch" in sw_holder else b"\x77")
+
+    transport = Transport(nk, ni)
+    sw_holder["transport"] = transport
+    sw = Switch(transport, ni)
+    sw_holder["switch"] = sw
+    er = EchoReactor()
+    sw.add_reactor("echo", er)
+    await transport.listen("127.0.0.1", port)
+    await sw.start()
+    return sw, er, nk
+
+
+def test_switch_two_nodes_exchange():
+    async def go():
+        sw1, er1, nk1 = await make_switch("n1")
+        sw2, er2, nk2 = await make_switch("n2")
+        peer = await sw1.dial_peer(f"{nk2.id}@{sw2.transport.listen_addr}")
+        assert peer.id == nk2.id
+        assert sw1.n_peers() == 1
+        # wait for inbound registration on sw2
+        for _ in range(50):
+            if sw2.n_peers() == 1:
+                break
+            await asyncio.sleep(0.05)
+        assert sw2.n_peers() == 1
+        await peer.send(EchoReactor.CHAN, b"ping:hello")
+        pid, msg = await asyncio.wait_for(er2.received.get(), 5)
+        assert (pid, msg) == (nk1.id, b"ping:hello")
+        pid, msg = await asyncio.wait_for(er1.received.get(), 5)
+        assert (pid, msg) == (nk2.id, b"echo:hello")
+        # broadcast reaches the peer
+        sw2.broadcast(EchoReactor.CHAN, b"to-everyone")
+        pid, msg = await asyncio.wait_for(er1.received.get(), 5)
+        assert msg == b"to-everyone"
+        await sw1.stop(); await sw2.stop()
+
+    run(go())
+
+
+def test_switch_rejects_self_and_duplicate():
+    async def go():
+        sw1, _, nk1 = await make_switch("n1")
+        sw2, _, nk2 = await make_switch("n2")
+        with pytest.raises(Exception):
+            await sw1.dial_peer(f"{nk1.id}@{sw1.transport.listen_addr}")
+        await sw1.dial_peer(f"{nk2.id}@{sw2.transport.listen_addr}")
+        with pytest.raises(Exception):
+            await sw1.dial_peer(f"{nk2.id}@{sw2.transport.listen_addr}")
+        assert sw1.n_peers() == 1
+        await sw1.stop(); await sw2.stop()
+
+    run(go())
+
+
+def test_switch_stop_peer_removes_both_sides():
+    async def go():
+        sw1, er1, nk1 = await make_switch("n1")
+        sw2, er2, nk2 = await make_switch("n2")
+        peer = await sw1.dial_peer(f"{nk2.id}@{sw2.transport.listen_addr}")
+        for _ in range(50):
+            if sw2.n_peers() == 1:
+                break
+            await asyncio.sleep(0.05)
+        await sw1.stop_peer_for_error(peer, "test teardown")
+        assert sw1.n_peers() == 0
+        # sw2 notices the closed conn
+        for _ in range(100):
+            if sw2.n_peers() == 0:
+                break
+            await asyncio.sleep(0.05)
+        assert sw2.n_peers() == 0
+        await sw1.stop(); await sw2.stop()
+
+    run(go())
+
+
+def test_transport_id_mismatch_rejected():
+    async def go():
+        sw1, _, nk1 = await make_switch("n1")
+        sw2, _, nk2 = await make_switch("n2")
+        fake_id = NodeKey.generate().id
+        with pytest.raises(Exception):
+            await sw1.dial_peer(f"{fake_id}@{sw2.transport.listen_addr}")
+        assert sw1.n_peers() == 0
+        await sw1.stop(); await sw2.stop()
+
+    run(go())
+
+
+def test_addrbook_basics(tmp_path):
+    book = AddrBook(str(tmp_path / "addrbook.json"))
+    nk = [NodeKey.generate() for _ in range(5)]
+    for i, k in enumerate(nk):
+        assert book.add_address(f"{k.id}@127.0.0.1:{26000 + i}")
+    assert book.size() == 5
+    # no duplicates
+    assert not book.add_address(f"{nk[0].id}@127.0.0.1:26000")
+    # our own address never enters
+    me = NodeKey.generate()
+    book.add_our_address(me.id)
+    assert not book.add_address(f"{me.id}@127.0.0.1:9")
+    # graduation to old bucket
+    book.mark_good(nk[0].id)
+    # pick/selection return something sane
+    assert book.pick_address() is not None
+    assert 1 <= len(book.get_selection()) <= 5
+    # bad addresses get filtered
+    for _ in range(3):
+        book.mark_attempt(nk[1].id)
+    sel = set(book.get_selection(10))
+    assert all(nk[1].id not in a for a in sel)
+    # persistence
+    book.save()
+    book2 = AddrBook(str(tmp_path / "addrbook.json"))
+    assert book2.size() == 5
+    assert book2._addrs[nk[0].id].bucket_type == "old"
