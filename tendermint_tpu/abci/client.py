@@ -79,7 +79,7 @@ class Client(Service):
     def submit(self, req) -> asyncio.Task:
         """Fire a request without awaiting — the async-pipelined
         DeliverTx path (reference: socket_client.go DeliverTxAsync)."""
-        return asyncio.get_event_loop().create_task(self.deliver(req))
+        return asyncio.get_running_loop().create_task(self.deliver(req))
 
 
 class LocalClient(Client):
@@ -177,7 +177,7 @@ class SocketClient(Client):
         if self._conn_err is not None:
             raise ABCIClientError(f"connection lost: {self._conn_err}")
         assert self._writer is not None, "client not started"
-        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
         await self._pending.put(fut)
         write_frame(self._writer, req)
         await self._writer.drain()

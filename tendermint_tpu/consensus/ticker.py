@@ -26,7 +26,7 @@ class TimeoutTicker:
                 return  # stale schedule, keep the newer timer
             self._timer.cancel()
         self._current = ti
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         self._timer = loop.call_later(ti.duration_s, self._fire, ti)
 
     def _fire(self, ti: TimeoutInfo) -> None:

@@ -71,7 +71,7 @@ class Service:
 
     def spawn(self, coro, name: str | None = None) -> asyncio.Task:
         """Run a coroutine under this service's supervision."""
-        task = asyncio.get_event_loop().create_task(coro, name=name)
+        task = asyncio.get_running_loop().create_task(coro, name=name)
         self._tasks.append(task)
         task.add_done_callback(self._on_task_done)
         return task

@@ -16,6 +16,7 @@ toggles around ApplyBlock's Commit (reference updateMtx).
 from __future__ import annotations
 
 import asyncio
+import logging
 import os
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -25,6 +26,9 @@ from ..config import MempoolConfig
 from ..libs.clist import CList
 from ..types.tx import tx_hash
 from . import Mempool
+
+
+logger = logging.getLogger("mempool")
 
 
 class TxInMempoolError(Exception):
@@ -92,9 +96,12 @@ class CListMempool(Mempool):
         self._tx_bytes = 0
         self._unlocked = asyncio.Event()
         self._unlocked.set()
-        # txs committed while a CheckTx was awaiting the app — checked
-        # on resume so an in-flight tx can't re-enter after its block
-        self._recently_committed: OrderedDict[bytes, None] = OrderedDict()
+        # tx key → update generation at commit time: an in-flight CheckTx
+        # drops its tx only if the tx committed at a generation >= the one
+        # snapshotted before the app call, so old commits never blackhole
+        # a fresh resubmission
+        self._update_gen = 0
+        self._recently_committed: OrderedDict[bytes, int] = OrderedDict()
         self._wal = None
         self._notify_available: asyncio.Event = asyncio.Event()
         if config.wal_dir:
@@ -147,16 +154,26 @@ class CListMempool(Mempool):
 
     def _rewrite_wal(self) -> None:
         """Compact the WAL to the current pending set (runs per block,
-        not per tx — so the file is the pending set, not a history)."""
+        not per tx — so the file is the pending set, not a history).
+        Best-effort: a disk error here must not take down the commit
+        path, only the refill-after-crash convenience."""
         if not self._wal:
             return
-        tmp = self._wal_path + ".tmp"
-        with open(tmp, "wb") as f:
-            for mtx in self.txs:
-                f.write(len(mtx.tx).to_bytes(4, "big") + mtx.tx)
-        self._wal.close()
-        os.replace(tmp, self._wal_path)
-        self._wal = open(self._wal_path, "ab")
+        try:
+            tmp = self._wal_path + ".tmp"
+            with open(tmp, "wb") as f:
+                for mtx in self.txs:
+                    f.write(len(mtx.tx).to_bytes(4, "big") + mtx.tx)
+            self._wal.close()
+            os.replace(tmp, self._wal_path)
+            self._wal = open(self._wal_path, "ab")
+        except OSError:
+            logger.exception("mempool WAL rewrite failed; disabling WAL")
+            try:
+                self._wal.close()
+            except OSError:
+                pass
+            self._wal = None
 
     def close_wal(self) -> None:
         if self._wal:
@@ -191,13 +208,16 @@ class CListMempool(Mempool):
                 e.value.senders.add(tx_info["sender"])
             raise TxInMempoolError("tx already in cache")
 
+        gen_before = self._update_gen
         res = await self.client.check_tx(abci.RequestCheckTx(tx=tx))
 
         # The commit window may have opened while we awaited the app:
-        # wait it out, and drop the tx if its block just committed
-        # (reference holds updateMtx.RLock across all of CheckTx).
+        # wait it out, and drop the tx only if it committed during this
+        # CheckTx's in-flight window — an older commit of the same tx
+        # must not blackhole a legitimate resubmission (reference holds
+        # updateMtx.RLock across all of CheckTx).
         await self._unlocked.wait()
-        if key in self._recently_committed:
+        if self._recently_committed.get(key, -1) > gen_before:
             return res
 
         if self.postcheck is not None and res.code == abci.CODE_TYPE_OK:
@@ -273,9 +293,10 @@ class CListMempool(Mempool):
         if postcheck is not None:
             self.postcheck = postcheck
 
+        self._update_gen += 1
         for tx, res in zip(txs, results):
             key = tx_hash(tx)
-            self._recently_committed[key] = None
+            self._recently_committed[key] = self._update_gen
             while len(self._recently_committed) > self.config.cache_size:
                 self._recently_committed.popitem(last=False)
             if getattr(res, "code", 0) == abci.CODE_TYPE_OK:

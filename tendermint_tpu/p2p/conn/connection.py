@@ -114,6 +114,7 @@ class MConnection(Service):
         self.on_error = on_error
         self._send_signal = asyncio.Event()
         self._pong_pending = asyncio.Event()
+        self._closed = asyncio.Event()
         self._send_bucket = _TokenBucket(self.config.send_rate)
         self._recv_bucket = _TokenBucket(self.config.recv_rate)
         self._errored = False
@@ -124,12 +125,14 @@ class MConnection(Service):
         self.spawn(self._ping_routine(), "mconn-ping")
 
     async def on_stop(self) -> None:
+        self._closed.set()
         self.conn.close()
 
     def _error(self, exc: Exception) -> None:
         if self._errored:
             return
         self._errored = True
+        self._closed.set()
         if self.on_error is not None:
             self.on_error(exc)
 
@@ -137,11 +140,23 @@ class MConnection(Service):
 
     async def send(self, chan_id: int, msg: bytes) -> bool:
         """Queue a message; awaits if the channel queue is full
-        (reference Peer.Send blocking semantics)."""
+        (reference Peer.Send blocking semantics). The wait is raced
+        against connection death — a full queue on a dead conn would
+        otherwise strand the caller forever."""
         ch = self.channels.get(chan_id)
         if ch is None or not self.is_running:
             return False
-        await ch.queue.put(msg)
+        put = asyncio.ensure_future(ch.queue.put(msg))
+        closed = asyncio.ensure_future(self._closed.wait())
+        try:
+            done, _ = await asyncio.wait(
+                {put, closed}, return_when=asyncio.FIRST_COMPLETED)
+        finally:
+            for f in (put, closed):
+                if not f.done():
+                    f.cancel()
+        if put not in done or put.cancelled():
+            return False
         self._send_signal.set()
         return True
 
@@ -171,9 +186,13 @@ class MConnection(Service):
 
     async def _send_routine(self) -> None:
         try:
+            throttle = self.config.flush_throttle_ms / 1000.0
+            last_flush = time.monotonic()
             while True:
                 ch = self._pick_channel()
                 if ch is None:
+                    # flush whatever is buffered before going idle
+                    await self.conn.drain()
                     self._send_signal.clear()
                     # decay recently_sent while idle (reference: 2x/s)
                     for c in self.channels.values():
@@ -186,7 +205,14 @@ class MConnection(Service):
                 await self._send_bucket.consume(len(pkt))
                 self.conn.write_frame(pkt)
                 ch.recently_sent += len(pkt)
-                await self.conn.drain()
+                # Throttled flush (reference flushThrottle): draining per
+                # 1KB packet would serialize a block part into ~1000
+                # scheduler round-trips; drain only every flush interval,
+                # plus once when the queues run dry above.
+                now = time.monotonic()
+                if now - last_flush >= throttle:
+                    await self.conn.drain()
+                    last_flush = now
         except asyncio.CancelledError:
             raise
         except Exception as e:

@@ -50,7 +50,8 @@ class NodeKey:
     def save(self, path: str) -> None:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         tmp = path + ".tmp"
-        with open(tmp, "w") as f:
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
             json.dump({"type": "ed25519",
                        "priv_key": self.priv_key.bytes().hex()}, f)
         os.replace(tmp, path)

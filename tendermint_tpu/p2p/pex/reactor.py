@@ -44,7 +44,7 @@ class PEXReactor(Reactor):
                                   send_queue_capacity=10, name="pex")]
 
     async def start(self) -> None:
-        self._task = asyncio.get_event_loop().create_task(
+        self._task = asyncio.get_running_loop().create_task(
             self._ensure_peers_routine())
 
     async def stop(self) -> None:
@@ -82,9 +82,17 @@ class PEXReactor(Reactor):
             await peer.send(PEX_CHANNEL, json.dumps(
                 {"type": _MSG_ADDRS, "addrs": sel}).encode())
             if self.seed_mode and peer.outbound is False:
-                # seeds serve addresses then disconnect
-                await asyncio.sleep(0.5)
-                await self.switch.stop_peer_gracefully(peer)
+                # Seeds serve addresses then disconnect. receive() runs on
+                # the peer's own mconn recv task, so the stop must go
+                # through a fresh task or it cancels itself mid-teardown
+                # (same invariant as Switch._on_peer_receive).
+                sw = self.switch
+
+                async def _drop(p=peer):
+                    await asyncio.sleep(0.5)
+                    await sw.stop_peer_gracefully(p)
+
+                asyncio.get_running_loop().create_task(_drop())
         elif t == _MSG_ADDRS:
             if peer.id not in self._requested:
                 raise ValueError("unsolicited pex addrs")
@@ -125,6 +133,7 @@ class PEXReactor(Reactor):
         exclude = set(sw.peers) | {
             a.split("@", 1)[0] for a in sw.dialing if "@" in a}
         to_dial = sw.max_outbound - sw._n_outbound()
+        picked = []
         for _ in range(to_dial):
             addr = self.book.pick_address(exclude=exclude)
             if addr is None:
@@ -132,10 +141,18 @@ class PEXReactor(Reactor):
             exclude.add(addr.split("@", 1)[0])
             nid = addr.split("@", 1)[0]
             self.book.mark_attempt(nid)
+            picked.append(addr)
+
+        # Dial concurrently — serial dials to dead addresses would stall
+        # peer acquisition by dial_timeout each (reference DialPeersAsync).
+        async def _dial_one(a: str) -> None:
             try:
-                await sw.dial_peer(addr)
+                await sw.dial_peer(a)
             except Exception:
-                continue
+                pass
+
+        if picked:
+            await asyncio.gather(*(_dial_one(a) for a in picked))
         # top up the book by asking a connected peer
         if self.book.size() < 16 and sw.peers:
             import random as _r

@@ -139,6 +139,12 @@ class Switch(Service):
         for r in self.reactors.values():
             r.init_peer(peer)
         await peer.start()
+        # Re-check after the await: a simultaneous cross-dial can land a
+        # second conn for the same node id while this one was starting;
+        # check+insert below is atomic (no await between them).
+        if ni.node_id in self.peers:
+            await peer.stop()
+            raise SwitchError("duplicate peer (cross-dial race)")
         self.peers[ni.node_id] = peer
         for r in self.reactors.values():
             try:
@@ -191,7 +197,7 @@ class Switch(Service):
     # -- teardown --
 
     def _on_peer_error(self, peer: Peer, exc: Exception) -> None:
-        asyncio.get_event_loop().create_task(
+        asyncio.get_running_loop().create_task(
             self.stop_peer_for_error(peer, exc))
 
     async def stop_peer_for_error(self, peer: Peer, reason) -> None:
@@ -247,17 +253,21 @@ class Switch(Service):
 
     async def _on_peer_receive(self, peer: Peer, chan_id: int,
                                msg: bytes) -> None:
+        # NB: this coroutine runs on the peer's own MConnection recv task.
+        # Stopping the peer from here would cancel the very task we're on,
+        # aborting stop_peer_for_error before it schedules the persistent
+        # reconnect — so teardown always goes through a fresh task.
         reactor = self.chan_to_reactor.get(chan_id)
         if reactor is None:
-            await self.stop_peer_for_error(
-                peer, f"msg on unregistered channel {chan_id:#x}")
+            self._on_peer_error(
+                peer, RuntimeError(f"msg on unregistered channel {chan_id:#x}"))
             return
         try:
             await reactor.receive(chan_id, peer, msg)
         except Exception as e:
             self.logger.warning("reactor %s receive error from %r: %s",
                                 reactor.name, peer, e)
-            await self.stop_peer_for_error(peer, e)
+            self._on_peer_error(peer, e)
 
     # -- broadcast --
 

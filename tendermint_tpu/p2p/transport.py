@@ -26,7 +26,12 @@ class HandshakeError(TransportError):
 class Transport:
     def __init__(self, node_key: NodeKey, node_info_fn,
                  handshake_timeout: float = 20.0,
-                 dial_timeout: float = 3.0):
+                 dial_timeout: float = 3.0,
+                 max_pending_handshakes: int = 64):
+        # Pre-auth DoS bound: an attacker stalling mid-handshake holds a
+        # slot for at most handshake_timeout; beyond the cap new dialers
+        # are refused at accept, before any crypto work.
+        self._handshake_slots = asyncio.Semaphore(max_pending_handshakes)
         self.node_key = node_key
         # node_info is late-bound: listen addr isn't known until Listen
         self.node_info_fn = node_info_fn
@@ -47,13 +52,22 @@ class Transport:
             self._on_accept, host, port)
 
     async def _on_accept(self, reader, writer) -> None:
-        try:
-            conn, ni = await asyncio.wait_for(
-                self._upgrade(reader, writer), self.handshake_timeout)
-        except Exception:
+        if self._handshake_slots.locked():
             writer.close()
             return
-        await self._accept_queue.put((conn, ni))
+        async with self._handshake_slots:
+            try:
+                conn, ni = await asyncio.wait_for(
+                    self._upgrade(reader, writer), self.handshake_timeout)
+            except Exception:
+                writer.close()
+                return
+        try:
+            # Never block holding an authenticated socket: if the Switch
+            # isn't draining the queue, shed the newest connection.
+            self._accept_queue.put_nowait((conn, ni))
+        except asyncio.QueueFull:
+            conn.close()
 
     async def accept(self) -> tuple[SecretConnection, NodeInfo]:
         return await self._accept_queue.get()

@@ -69,7 +69,7 @@ def test_clist_waitable_iteration():
                 nxt = await e.next_wait()
                 e = nxt if nxt is not None else await cl.front_wait()
 
-        t = asyncio.get_event_loop().create_task(reader())
+        t = asyncio.get_running_loop().create_task(reader())
         await asyncio.sleep(0)
         cl.push_back("a")
         await asyncio.sleep(0)
@@ -78,11 +78,11 @@ def test_clist_waitable_iteration():
         await asyncio.wait_for(t, 2)
         assert seen == ["a", "b", "c"]
 
-    asyncio.get_event_loop().run_until_complete(run())
+    asyncio.run(run())
 
 
 def run(coro):
-    return asyncio.get_event_loop().run_until_complete(coro)
+    return asyncio.run(coro)
 
 
 def test_check_tx_admit_and_reap():
@@ -169,11 +169,25 @@ def test_recheck_drops_stale():
     assert pool.size() == 0
 
 
+def test_committed_failed_tx_can_resubmit():
+    """A tx that committed with a non-OK code must be resubmittable:
+    the committed-during-checktx guard only applies to commits that
+    landed while that CheckTx was in flight."""
+    pool, _ = make_pool()
+    run(pool.check_tx(tx(7)))
+    failed = [abci.ResponseDeliverTx(code=5)]
+    run(pool.update(2, [tx(7)], failed))
+    assert pool.size() == 0
+    res = run(pool.check_tx(tx(7)))
+    assert res.code == abci.CODE_TYPE_OK
+    assert pool.size() == 1
+
+
 def test_lock_blocks_check_tx():
     async def scenario():
         pool, _ = make_pool()
         pool.lock()
-        task = asyncio.get_event_loop().create_task(pool.check_tx(tx(1)))
+        task = asyncio.get_running_loop().create_task(pool.check_tx(tx(1)))
         await asyncio.sleep(0.01)
         assert not task.done()
         assert pool.size() == 0

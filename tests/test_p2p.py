@@ -21,11 +21,11 @@ from tendermint_tpu.p2p.transport import HandshakeError, Transport
 
 
 def run(coro):
-    return asyncio.get_event_loop().run_until_complete(coro)
+    return asyncio.run(coro)
 
 
 async def tcp_pair():
-    loop = asyncio.get_event_loop()
+    loop = asyncio.get_running_loop()
     fut = loop.create_future()
 
     def factory(r, w):
