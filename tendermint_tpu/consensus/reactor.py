@@ -1,0 +1,672 @@
+"""Consensus reactor: gossips round state, proposals, block parts and
+votes between peers (reference: consensus/reactor.go:27-30, the four
+channels 0x20-0x23; gossipDataRoutine :492, gossipVotesRoutine :632,
+queryMaj23Routine :765; PeerState :932).
+
+Redesign notes (asyncio, not goroutines): each peer gets three
+supervised tasks (data / votes / maj23) started on add_peer and
+cancelled on remove_peer. Outbound state changes arrive via
+ConsensusState.broadcast_hooks — a synchronous fan-out the reactor
+turns into non-blocking `Switch.broadcast` calls — rather than the
+reference's internal event switch. All inbound consensus messages are
+funneled into the consensus state's single serialized receive queue
+(`add_peer_msg`), preserving the reference's one-event-loop invariant.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from ..libs.bits import BitArray
+from ..p2p.conn.connection import ChannelDescriptor
+from ..p2p.switch import Reactor
+from ..types.block import PartSetHeader
+from ..types.vote import VoteType
+from . import messages as m
+from .cstypes import RoundState, RoundStep
+from .state import ConsensusState
+
+logger = logging.getLogger("consensus.reactor")
+
+STATE_CHANNEL = 0x20
+DATA_CHANNEL = 0x21
+VOTE_CHANNEL = 0x22
+VOTE_SET_BITS_CHANNEL = 0x23
+
+PEER_GOSSIP_SLEEP = 0.1   # reference: peerGossipSleepDuration (100ms)
+PEER_QUERY_MAJ23_SLEEP = 2.0  # reference: peerQueryMaj23SleepDuration
+
+# Rounds of vote bit-arrays retained per peer; a byzantine peer spinning
+# rounds must not grow our bookkeeping without bound.
+_MAX_TRACKED_ROUNDS = 64
+
+
+class PeerState:
+    """What we know about one peer's view of consensus
+    (reference: consensus/reactor.go:932 PeerState + PeerRoundState)."""
+
+    def __init__(self, peer):
+        self.peer = peer
+        self.height = 0
+        self.round = -1
+        self.step = RoundStep.NEW_HEIGHT
+        self.start_time = 0.0
+        self.proposal = False
+        self.proposal_block_parts_header: PartSetHeader | None = None
+        self.proposal_block_parts: BitArray | None = None
+        self.proposal_pol_round = -1
+        self.proposal_pol: BitArray | None = None
+        self.prevotes: dict[int, BitArray] = {}
+        self.precommits: dict[int, BitArray] = {}
+        self.last_commit_round = -1
+        self.last_commit: BitArray | None = None
+        self.catchup_commit_round = -1
+        self.catchup_commit: BitArray | None = None
+        # stats (reference PeerState.Stats → MarkPeerAsGood)
+        self.votes_received = 0
+        self.block_parts_received = 0
+
+    # -- bit-array bookkeeping --
+
+    def _ensure(self, d: dict[int, BitArray], round_: int, n: int) -> BitArray:
+        ba = d.get(round_)
+        if ba is None or ba.size != n:
+            ba = BitArray(n)
+            d[round_] = ba
+            while len(d) > _MAX_TRACKED_ROUNDS:
+                del d[min(d)]
+        return ba
+
+    def get_vote_bits(self, height: int, round_: int,
+                      type_: int) -> BitArray | None:
+        """reference: PeerState.getVoteBitArray."""
+        if self.height == height:
+            if type_ == VoteType.PREVOTE:
+                if round_ == self.proposal_pol_round and \
+                        self.proposal_pol is not None:
+                    return self.proposal_pol
+                return self.prevotes.get(round_)
+            if round_ == self.catchup_commit_round and \
+                    self.catchup_commit is not None:
+                return self.catchup_commit
+            return self.precommits.get(round_)
+        if self.height == height + 1 and type_ == VoteType.PRECOMMIT \
+                and round_ == self.last_commit_round:
+            return self.last_commit
+        return None
+
+    def ensure_vote_bits(self, height: int, round_: int, type_: int,
+                         num_validators: int) -> BitArray | None:
+        if self.height != height:
+            return self.get_vote_bits(height, round_, type_)
+        d = self.prevotes if type_ == VoteType.PREVOTE else self.precommits
+        self._ensure(d, round_, num_validators)
+        return self.get_vote_bits(height, round_, type_)
+
+    def set_has_vote(self, height: int, round_: int, type_: int,
+                     index: int, num_validators: int = 0) -> None:
+        bits = self.ensure_vote_bits(height, round_, type_,
+                                     num_validators) if num_validators \
+            else self.get_vote_bits(height, round_, type_)
+        if bits is not None and 0 <= index < bits.size:
+            bits.set(index, True)
+
+    def set_has_part(self, height: int, round_: int, index: int) -> None:
+        if self.height == height and self.round == round_ and \
+                self.proposal_block_parts is not None and \
+                0 <= index < self.proposal_block_parts.size:
+            self.proposal_block_parts.set(index, True)
+
+    # -- message application (all reference Apply*Message methods) --
+
+    def apply_new_round_step(self, msg: m.NewRoundStepMessage) -> None:
+        ph, pr = self.height, self.round
+        if msg.height < ph or (msg.height == ph and msg.round < pr):
+            return  # stale
+        self.height = msg.height
+        self.round = msg.round
+        self.step = RoundStep(msg.step)
+        self.start_time = time.monotonic() - msg.seconds_since_start_time
+        if ph != msg.height or pr != msg.round:
+            self.proposal = False
+            self.proposal_block_parts_header = None
+            self.proposal_block_parts = None
+            self.proposal_pol_round = -1
+            self.proposal_pol = None
+        if ph != msg.height:
+            # Their precommits for the previous height become last-commit
+            # (reference ApplyNewRoundStepMessage).
+            if ph + 1 == msg.height and pr == msg.last_commit_round:
+                self.last_commit = self.precommits.get(pr)
+            else:
+                self.last_commit = None
+            self.last_commit_round = msg.last_commit_round
+            self.prevotes = {}
+            self.precommits = {}
+            self.catchup_commit_round = -1
+            self.catchup_commit = None
+
+    def apply_new_valid_block(self, msg: m.NewValidBlockMessage) -> None:
+        if self.height != msg.height:
+            return
+        if self.round != msg.round and not msg.is_commit:
+            return
+        self.proposal_block_parts_header = msg.block_parts_header
+        self.proposal_block_parts = msg.block_parts
+
+    def set_proposal(self, proposal) -> None:
+        if self.height != proposal.height or self.round != proposal.round:
+            return
+        if self.proposal:
+            return
+        self.proposal = True
+        if self.proposal_block_parts is not None:
+            return  # already set via NewValidBlock
+        self.proposal_pol_round = proposal.pol_round
+        self.proposal_pol = None  # filled by ProposalPOLMessage
+
+    def set_proposal_parts_header(self, header: PartSetHeader) -> None:
+        if self.proposal_block_parts is None:
+            self.proposal_block_parts_header = header
+            self.proposal_block_parts = BitArray(header.total)
+
+    def apply_proposal_pol(self, msg: m.ProposalPOLMessage) -> None:
+        if self.height != msg.height:
+            return
+        if self.proposal_pol_round != msg.proposal_pol_round:
+            return
+        self.proposal_pol = msg.proposal_pol
+
+    def apply_has_vote(self, msg: m.HasVoteMessage) -> None:
+        if self.height != msg.height:
+            return
+        self.set_has_vote(msg.height, msg.round, msg.type, msg.index)
+
+    def apply_vote_set_bits(self, msg: m.VoteSetBitsMessage,
+                            our_votes: BitArray | None) -> None:
+        bits = self.get_vote_bits(msg.height, msg.round, msg.type)
+        if bits is None:
+            return
+        if our_votes is not None and our_votes.size == bits.size:
+            # reference: ours OR (theirs AND NOT ours) == ours OR theirs
+            merged = bits.or_(msg.votes) if msg.votes.size == bits.size \
+                else bits
+            d = self.prevotes if msg.type == VoteType.PREVOTE \
+                else self.precommits
+            if msg.height == self.height and msg.round in d:
+                d[msg.round] = merged
+        elif msg.votes.size == bits.size:
+            d = self.prevotes if msg.type == VoteType.PREVOTE \
+                else self.precommits
+            if msg.height == self.height and msg.round in d:
+                d[msg.round] = msg.votes
+
+    def ensure_catchup_commit(self, height: int, round_: int,
+                              num_validators: int) -> None:
+        """reference: PeerState.EnsureCatchupCommitRound."""
+        if self.height != height or self.catchup_commit_round == round_:
+            return
+        self.catchup_commit_round = round_
+        if round_ == self.round:
+            self.catchup_commit = self.precommits.get(round_)
+        else:
+            self.catchup_commit = BitArray(num_validators)
+
+    def __repr__(self) -> str:
+        return (f"PeerState({self.peer.id[:8]} h={self.height} "
+                f"r={self.round} s={self.step.name})")
+
+
+def _new_round_step_msg(rs: RoundState) -> m.NewRoundStepMessage:
+    lcr = rs.last_commit.round if rs.last_commit is not None else -1
+    return m.NewRoundStepMessage(
+        height=rs.height, round=rs.round, step=int(rs.step),
+        seconds_since_start_time=max(0, int(time.monotonic() -
+                                            rs.start_time)),
+        last_commit_round=lcr)
+
+
+class ConsensusReactor(Reactor):
+    """reference: consensus/reactor.go ConsensusReactor."""
+
+    def __init__(self, cs: ConsensusState, wait_sync: bool = False,
+                 gossip_sleep: float = PEER_GOSSIP_SLEEP):
+        super().__init__("consensus")
+        self.cs = cs
+        self.wait_sync = wait_sync
+        self.gossip_sleep = gossip_sleep
+        self.peer_states: dict[str, PeerState] = {}
+        self._peer_tasks: dict[str, list[asyncio.Task]] = {}
+        cs.broadcast_hooks.append(self._on_cs_event)
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        # priorities/capacities follow reference reactor.go GetChannels
+        return [
+            ChannelDescriptor(id=STATE_CHANNEL, priority=6,
+                              send_queue_capacity=100, name="state"),
+            ChannelDescriptor(id=DATA_CHANNEL, priority=10,
+                              send_queue_capacity=100, name="data"),
+            ChannelDescriptor(id=VOTE_CHANNEL, priority=7,
+                              send_queue_capacity=100, name="vote"),
+            ChannelDescriptor(id=VOTE_SET_BITS_CHANNEL, priority=1,
+                              send_queue_capacity=2, name="votebits"),
+        ]
+
+    # -- lifecycle --
+
+    async def switch_to_consensus(self, state, skip_wal: bool = False) -> None:
+        """Fast-sync → consensus handoff (reference: SwitchToConsensus,
+        conR.conS.updateToState + start gossip for existing peers)."""
+        self.cs.update_to_state(state)
+        self.wait_sync = False
+        await self.cs.start()
+        for pid, ps in self.peer_states.items():
+            if pid not in self._peer_tasks:
+                self._start_gossip(ps)
+
+    async def stop(self) -> None:
+        for tasks in self._peer_tasks.values():
+            for t in tasks:
+                t.cancel()
+        self._peer_tasks.clear()
+
+    # -- peer lifecycle --
+
+    async def add_peer(self, peer) -> None:
+        ps = PeerState(peer)
+        self.peer_states[peer.id] = ps
+        # tell the new peer where we are (reference sendNewRoundStepMessage)
+        peer.try_send(STATE_CHANNEL, m.encode_consensus_msg(
+            _new_round_step_msg(self.cs.rs)))
+        if not self.wait_sync:
+            self._start_gossip(ps)
+
+    def _start_gossip(self, ps: PeerState) -> None:
+        loop = asyncio.get_running_loop()
+        tasks = [
+            loop.create_task(self._gossip_data_routine(ps),
+                             name=f"gossip-data-{ps.peer.id[:8]}"),
+            loop.create_task(self._gossip_votes_routine(ps),
+                             name=f"gossip-votes-{ps.peer.id[:8]}"),
+            loop.create_task(self._query_maj23_routine(ps),
+                             name=f"maj23-{ps.peer.id[:8]}"),
+        ]
+        self._peer_tasks[ps.peer.id] = tasks
+
+    async def remove_peer(self, peer, reason) -> None:
+        for t in self._peer_tasks.pop(peer.id, []):
+            t.cancel()
+        self.peer_states.pop(peer.id, None)
+
+    # -- inbound --
+
+    async def receive(self, chan_id: int, peer, msgb: bytes) -> None:
+        msg = m.decode_consensus_msg(msgb)
+        ps = self.peer_states.get(peer.id)
+        if ps is None:
+            return
+        if chan_id == STATE_CHANNEL:
+            if isinstance(msg, m.NewRoundStepMessage):
+                if msg.height < 1 or msg.round < 0 or \
+                        not 1 <= msg.step <= 8:
+                    raise ValueError("invalid NewRoundStep")
+                ps.apply_new_round_step(msg)
+            elif isinstance(msg, m.NewValidBlockMessage):
+                ps.apply_new_valid_block(msg)
+            elif isinstance(msg, m.HasVoteMessage):
+                ps.apply_has_vote(msg)
+            elif isinstance(msg, m.VoteSetMaj23Message):
+                await self._handle_maj23(ps, peer, msg)
+            else:
+                raise ValueError(f"bad msg on state channel: {type(msg)}")
+        elif chan_id == DATA_CHANNEL:
+            if self.wait_sync:
+                return
+            if isinstance(msg, m.ProposalMessage):
+                ps.set_proposal(msg.proposal)
+                self.cs.add_peer_msg(msg, peer.id)
+            elif isinstance(msg, m.ProposalPOLMessage):
+                ps.apply_proposal_pol(msg)
+            elif isinstance(msg, m.BlockPartMessage):
+                ps.set_has_part(msg.height, msg.round, msg.part.index)
+                ps.block_parts_received += 1
+                self.cs.add_peer_msg(msg, peer.id)
+            else:
+                raise ValueError(f"bad msg on data channel: {type(msg)}")
+        elif chan_id == VOTE_CHANNEL:
+            if self.wait_sync:
+                return
+            if isinstance(msg, m.VoteMessage):
+                v = msg.vote
+                n = len(self.cs.rs.validators) if self.cs.rs.validators \
+                    else 0
+                ps.ensure_vote_bits(v.height, v.round, int(v.type), n)
+                ps.set_has_vote(v.height, v.round, int(v.type),
+                                v.validator_index)
+                ps.votes_received += 1
+                self.cs.add_peer_msg(msg, peer.id)
+            else:
+                raise ValueError(f"bad msg on vote channel: {type(msg)}")
+        elif chan_id == VOTE_SET_BITS_CHANNEL:
+            if isinstance(msg, m.VoteSetBitsMessage):
+                rs = self.cs.rs
+                ours = None
+                if rs.height == msg.height and rs.votes is not None:
+                    vs = (rs.votes.prevotes(msg.round)
+                          if msg.type == VoteType.PREVOTE
+                          else rs.votes.precommits(msg.round))
+                    if vs is not None:
+                        ours = vs.bit_array_by_block_id(None) \
+                            if msg.block_id is None or msg.block_id.is_nil() \
+                            else vs.bit_array_by_block_id(msg.block_id)
+                ps.apply_vote_set_bits(msg, ours)
+            else:
+                raise ValueError(
+                    f"bad msg on votebits channel: {type(msg)}")
+
+    async def _handle_maj23(self, ps: PeerState, peer,
+                            msg: m.VoteSetMaj23Message) -> None:
+        """Peer claims +2/3 at (height, round, type, block_id): record it
+        and reply with which of those votes we already have
+        (reference reactor.go Receive StateChannel VoteSetMaj23)."""
+        rs = self.cs.rs
+        if rs.height != msg.height or rs.votes is None:
+            return
+        if not VoteType.is_valid(msg.type):
+            raise ValueError("invalid vote type in maj23")
+        rs.votes.set_peer_maj23(msg.round, VoteType(msg.type), peer.id,
+                                msg.block_id)
+        vs = (rs.votes.prevotes(msg.round) if msg.type == VoteType.PREVOTE
+              else rs.votes.precommits(msg.round))
+        our_bits = vs.bit_array_by_block_id(msg.block_id) if vs else None
+        if our_bits is None:
+            our_bits = BitArray(len(rs.validators) if rs.validators else 0)
+        await peer.send(VOTE_SET_BITS_CHANNEL, m.encode_consensus_msg(
+            m.VoteSetBitsMessage(height=msg.height, round=msg.round,
+                                 type=msg.type, block_id=msg.block_id,
+                                 votes=our_bits)))
+
+    # -- outbound broadcast (ConsensusState hooks) --
+
+    def _on_cs_event(self, event: str, payload) -> None:
+        if self.switch is None:
+            return
+        if event == "step":
+            rs: RoundState = payload
+            self.switch.broadcast(STATE_CHANNEL, m.encode_consensus_msg(
+                _new_round_step_msg(rs)))
+            if rs.valid_block is not None and \
+                    rs.valid_block_parts is not None:
+                self.switch.broadcast(
+                    STATE_CHANNEL,
+                    m.encode_consensus_msg(m.NewValidBlockMessage(
+                        height=rs.height, round=rs.round,
+                        block_parts_header=rs.valid_block_parts.header(),
+                        block_parts=rs.valid_block_parts.parts_bitarray,
+                        is_commit=rs.step == RoundStep.COMMIT)))
+        elif event == "valid_block":
+            rs = payload
+            if rs.proposal_block_parts is not None:
+                self.switch.broadcast(
+                    STATE_CHANNEL,
+                    m.encode_consensus_msg(m.NewValidBlockMessage(
+                        height=rs.height, round=rs.round,
+                        block_parts_header=rs.proposal_block_parts.header(),
+                        block_parts=rs.proposal_block_parts.parts_bitarray,
+                        is_commit=rs.step == RoundStep.COMMIT)))
+        elif event == "has_vote":
+            self.switch.broadcast(STATE_CHANNEL,
+                                  m.encode_consensus_msg(payload))
+
+    # -- gossip routines --
+
+    async def _gossip_data_routine(self, ps: PeerState) -> None:
+        """reference: gossipDataRoutine (reactor.go:492)."""
+        peer = ps.peer
+        try:
+            while True:
+                rs = self.cs.rs
+                # 1) send a proposal block part the peer lacks
+                if rs.height == ps.height and rs.round == ps.round and \
+                        rs.proposal_block_parts is not None and \
+                        ps.proposal_block_parts is not None and \
+                        rs.proposal_block_parts.has_header(
+                            ps.proposal_block_parts_header):
+                    if await self._send_missing_part(
+                            ps, rs.proposal_block_parts, rs.height,
+                            rs.round):
+                        continue
+                # 2) peer is behind: feed it parts of committed blocks
+                if ps.height != 0 and rs.height > ps.height:
+                    if await self._gossip_catchup_part(ps):
+                        continue
+                # 3) send the proposal itself (+POL)
+                if rs.height == ps.height and rs.proposal is not None \
+                        and not ps.proposal:
+                    await peer.send(DATA_CHANNEL, m.encode_consensus_msg(
+                        m.ProposalMessage(rs.proposal)))
+                    ps.set_proposal(rs.proposal)
+                    if rs.proposal_block_parts is not None:
+                        ps.set_proposal_parts_header(
+                            rs.proposal_block_parts.header())
+                    if rs.proposal.pol_round >= 0 and rs.votes is not None:
+                        pol = rs.votes.prevotes(rs.proposal.pol_round)
+                        if pol is not None:
+                            await peer.send(
+                                DATA_CHANNEL,
+                                m.encode_consensus_msg(m.ProposalPOLMessage(
+                                    height=rs.height,
+                                    proposal_pol_round=rs.proposal.pol_round,
+                                    proposal_pol=pol.bit_array())))
+                    continue
+                await asyncio.sleep(self.gossip_sleep)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("gossip data routine for %r died", ps)
+
+    async def _send_missing_part(self, ps: PeerState, parts, height: int,
+                                 round_: int) -> bool:
+        if ps.proposal_block_parts is None:
+            return False
+        missing = parts.parts_bitarray.sub(ps.proposal_block_parts)
+        idx, ok = missing.pick_random()
+        if not ok:
+            return False
+        part = parts.get_part(idx)
+        if part is None:
+            return False
+        await ps.peer.send(DATA_CHANNEL, m.encode_consensus_msg(
+            m.BlockPartMessage(height=height, round=round_, part=part)))
+        ps.set_has_part(height, round_, idx)
+        return True
+
+    async def _gossip_catchup_part(self, ps: PeerState) -> bool:
+        """Send one part of the block committed at the peer's height —
+        only once the peer advertises (via NewValidBlock from its
+        enterCommit) that it accepts this part-set; parts pushed before
+        then would be dropped on its floor and never re-sent
+        (reference: gossipDataForCatchup checks the headers match)."""
+        meta = self.cs.block_store.load_block_meta(ps.height)
+        if meta is None:
+            await asyncio.sleep(self.gossip_sleep)
+            return True
+        header = meta.block_id.part_set_header
+        if ps.proposal_block_parts is None or \
+                ps.proposal_block_parts_header != header:
+            await asyncio.sleep(self.gossip_sleep)
+            return True
+        missing = ps.proposal_block_parts.not_()
+        idx, ok = missing.pick_random()
+        if not ok:
+            await asyncio.sleep(self.gossip_sleep)
+            return True
+        part = self.cs.block_store.load_block_part(ps.height, idx)
+        if part is None:
+            await asyncio.sleep(self.gossip_sleep)
+            return True
+        await ps.peer.send(DATA_CHANNEL, m.encode_consensus_msg(
+            m.BlockPartMessage(height=ps.height, round=ps.round,
+                               part=part)))
+        ps.proposal_block_parts.set(idx, True)
+        return True
+
+    async def _gossip_votes_routine(self, ps: PeerState) -> None:
+        """reference: gossipVotesRoutine (reactor.go:632)."""
+        try:
+            while True:
+                rs = self.cs.rs
+                sent = False
+                if rs.height == ps.height:
+                    sent = await self._gossip_votes_for_height(rs, ps)
+                # peer is one height behind: our last commit
+                if not sent and ps.height != 0 and \
+                        rs.height == ps.height + 1 and \
+                        rs.last_commit is not None:
+                    sent = await self._pick_send_vote(ps, rs.last_commit)
+                # peer is far behind: commit from the block store
+                if not sent and ps.height != 0 and \
+                        rs.height >= ps.height + 2:
+                    sent = await self._gossip_catchup_commit(ps)
+                if not sent:
+                    await asyncio.sleep(self.gossip_sleep)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("gossip votes routine for %r died", ps)
+
+    async def _gossip_votes_for_height(self, rs: RoundState,
+                                       ps: PeerState) -> bool:
+        """reference: gossipVotesForHeight."""
+        votes = rs.votes
+        if votes is None:
+            return False
+        # peer is at a previous round: just send its round's votes
+        if ps.proposal_pol_round != -1 and ps.step <= RoundStep.PROPOSE:
+            pol = votes.prevotes(ps.proposal_pol_round)
+            if pol is not None and await self._pick_send_vote(ps, pol):
+                return True
+        if ps.step <= RoundStep.PREVOTE_WAIT and 0 <= ps.round <= rs.round:
+            pv = votes.prevotes(ps.round)
+            if pv is not None and await self._pick_send_vote(ps, pv):
+                return True
+        if ps.step <= RoundStep.PRECOMMIT_WAIT and \
+                0 <= ps.round <= rs.round:
+            pc = votes.precommits(ps.round)
+            if pc is not None and await self._pick_send_vote(ps, pc):
+                return True
+        if 0 <= ps.round <= rs.round:
+            pv = votes.prevotes(ps.round)
+            if pv is not None and await self._pick_send_vote(ps, pv):
+                return True
+        if ps.proposal_pol_round != -1:
+            pol = votes.prevotes(ps.proposal_pol_round)
+            if pol is not None and await self._pick_send_vote(ps, pol):
+                return True
+        return False
+
+    async def _gossip_catchup_commit(self, ps: PeerState) -> bool:
+        commit = self.cs.block_store.load_block_commit(ps.height)
+        if commit is None:
+            return False
+        # Rebuild votes from commit sigs; need that height's valset —
+        # reference uses LoadBlockCommit + ps.PickSendVote on a VoteSet
+        # view. We send the precommit of a random signer the peer lacks.
+        bits = ps.ensure_vote_bits(ps.height, commit.round,
+                                   VoteType.PRECOMMIT, len(commit.signatures))
+        if bits is None:
+            ps.ensure_catchup_commit(ps.height, commit.round,
+                                     len(commit.signatures))
+            bits = ps.catchup_commit
+        if bits is None:
+            return False
+        have = BitArray(len(commit.signatures))
+        for i, cs_ in enumerate(commit.signatures):
+            if cs_.for_block():
+                have.set(i, True)
+        missing = have.sub(bits)
+        idx, ok = missing.pick_random()
+        if not ok:
+            return False
+        vote = self._commit_to_vote(commit, idx)
+        if vote is None:
+            return False
+        await ps.peer.send(VOTE_CHANNEL, m.encode_consensus_msg(
+            m.VoteMessage(vote)))
+        bits.set(idx, True)
+        return True
+
+    def _commit_to_vote(self, commit, idx: int):
+        from ..types.vote import Vote
+        cs_ = commit.signatures[idx]
+        if not cs_.for_block():
+            return None
+        return Vote(type=VoteType.PRECOMMIT, height=commit.height,
+                    round=commit.round,
+                    block_id=cs_.block_id_for(commit.block_id),
+                    timestamp=cs_.timestamp,
+                    validator_address=cs_.validator_address,
+                    validator_index=idx, signature=cs_.signature)
+
+    async def _pick_send_vote(self, ps: PeerState, vs) -> bool:
+        """Pick one vote the peer lacks and send it
+        (reference: PeerState.PickSendVote)."""
+        peer_bits = ps.ensure_vote_bits(vs.height, vs.round, int(vs.type),
+                                        vs.size())
+        if peer_bits is None:
+            return False
+        ours = vs.bit_array()
+        missing = ours.sub(peer_bits)
+        idx, ok = missing.pick_random()
+        if not ok:
+            return False
+        vote = vs.get_by_index(idx)
+        if vote is None:
+            return False
+        ok = await ps.peer.send(VOTE_CHANNEL,
+                                m.encode_consensus_msg(m.VoteMessage(vote)))
+        if ok:
+            ps.set_has_vote(vote.height, vote.round, int(vote.type), idx)
+        return ok
+
+    async def _query_maj23_routine(self, ps: PeerState) -> None:
+        """Periodically tell peers which (h,r,type,blockID) we've seen
+        +2/3 votes for, so they can send us what we're missing
+        (reference: queryMaj23Routine reactor.go:765)."""
+        try:
+            while True:
+                await asyncio.sleep(PEER_QUERY_MAJ23_SLEEP)
+                rs = self.cs.rs
+                if rs.votes is None:
+                    continue
+                if rs.height == ps.height:
+                    for type_, vs in ((VoteType.PREVOTE,
+                                       rs.votes.prevotes(ps.round)),
+                                      (VoteType.PRECOMMIT,
+                                       rs.votes.precommits(ps.round))):
+                        if vs is None:
+                            continue
+                        bid, ok = vs.two_thirds_majority()
+                        if ok and bid is not None:
+                            await ps.peer.send(
+                                STATE_CHANNEL,
+                                m.encode_consensus_msg(m.VoteSetMaj23Message(
+                                    height=rs.height, round=ps.round,
+                                    type=int(type_), block_id=bid)))
+                # catchup: advertise the commit of the peer's height
+                if rs.height != ps.height and ps.height > 0 and \
+                        ps.height >= self.cs.block_store.base:
+                    commit = self.cs.block_store.load_block_commit(ps.height)
+                    if commit is not None:
+                        await ps.peer.send(
+                            STATE_CHANNEL,
+                            m.encode_consensus_msg(m.VoteSetMaj23Message(
+                                height=ps.height, round=commit.round,
+                                type=int(VoteType.PRECOMMIT),
+                                block_id=commit.block_id)))
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("maj23 routine for %r died", ps)

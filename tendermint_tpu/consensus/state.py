@@ -252,7 +252,13 @@ class ConsensusState(Service):
                 self.logger.warning("rejecting block part from %r: %s",
                                     qm.peer_id, e)
                 return
-            if added and self.rs.proposal_complete():
+            if added and self.rs.step == RoundStep.COMMIT and \
+                    self.rs.proposal_block is not None:
+                # catchup: block completed while waiting in commit with
+                # no Proposal (reference addProposalBlockPart →
+                # tryFinalizeCommit when cs.Step == RoundStepCommit)
+                await self._try_finalize_commit(self.rs.height)
+            elif added and self.rs.proposal_complete():
                 await self._proposal_completed()
         elif isinstance(msg, m.VoteMessage):
             await self._try_add_vote(msg.vote, qm.peer_id)
@@ -537,6 +543,11 @@ class ConsensusState(Service):
                 rs.proposal_block_parts = PartSet(
                     bid.part_set_header.total, bid.part_set_header.hash
                 )
+                # advertise which part-set we now accept so peers'
+                # catchup gossip starts feeding us the block
+                # (reference enterCommit → PublishEventValidBlock →
+                # reactor broadcasts NewValidBlockMessage)
+                self._broadcast("valid_block", rs)
         await self._try_finalize_commit(height)
 
     async def _try_finalize_commit(self, height: int) -> None:
