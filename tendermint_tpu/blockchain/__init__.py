@@ -1,0 +1,30 @@
+"""Fast sync: catch up to the chain head by downloading committed
+blocks from peers in parallel and applying them without running
+consensus (reference: blockchain/ — v0 pool design with v2's
+deterministic, IO-free core for testability).
+
+The TPU twist (SURVEY §3.5): block verification during catch-up is the
+hottest loop — one VerifyCommitLight per block. Here contiguous runs
+of fetched blocks are verified as ONE signature batch across blocks
+(`reactor.BlockchainReactor._try_sync`), which is where the
+sub-100ms-per-block headline number comes from.
+"""
+
+from .msgs import (
+    BlockRequestMessage,
+    BlockResponseMessage,
+    NoBlockResponseMessage,
+    StatusRequestMessage,
+    StatusResponseMessage,
+    decode_bc_msg,
+    encode_bc_msg,
+)
+from .pool import BlockPool
+from .reactor import BLOCKCHAIN_CHANNEL, BlockchainReactor
+
+__all__ = [
+    "BlockPool", "BlockchainReactor", "BLOCKCHAIN_CHANNEL",
+    "StatusRequestMessage", "StatusResponseMessage", "BlockRequestMessage",
+    "BlockResponseMessage", "NoBlockResponseMessage",
+    "encode_bc_msg", "decode_bc_msg",
+]

@@ -1,0 +1,259 @@
+"""Fast-sync reactor (reference: blockchain/v0/reactor.go, channel
+0x40): serves committed blocks to catching-up peers and, when started
+in fast-sync mode, drives the BlockPool to download, verify and apply
+blocks until caught up, then hands off to consensus
+(SwitchToConsensus, reference v0/reactor.go poolRoutine).
+
+TPU-first redesign of the hot loop: the reference verifies one commit
+per block (`VerifyCommitLight`, sequential per-sig). Here a contiguous
+window of fetched blocks is verified as ONE signature batch
+(`_batch_verify_window`) — every (pubkey, signbytes, sig) triple from
+up to BATCH_WINDOW commits goes to the device in a single
+BatchVerifier call, amortizing dispatch and filling MXU lanes
+(SURVEY §3.5: batch across blocks, not just within a commit)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from ..crypto.batch import BatchVerifier
+from ..p2p.conn.connection import ChannelDescriptor
+from ..p2p.switch import Reactor
+from ..types.block import BlockID
+from ..types.validator_set import VerificationError
+from .msgs import (
+    BlockRequestMessage,
+    BlockResponseMessage,
+    NoBlockResponseMessage,
+    StatusRequestMessage,
+    StatusResponseMessage,
+    decode_bc_msg,
+    encode_bc_msg,
+)
+from .pool import BlockPool
+
+logger = logging.getLogger("blockchain")
+
+BLOCKCHAIN_CHANNEL = 0x40
+
+TRY_SYNC_INTERVAL = 0.01          # reference trySyncTicker (10ms)
+STATUS_UPDATE_INTERVAL = 10.0     # reference statusUpdateTicker
+SWITCH_TO_CONSENSUS_INTERVAL = 1.0
+BATCH_WINDOW = 16                 # blocks per device verification batch
+
+
+def _batch_verify_window(vals, chain_id: str, items):
+    """Verify the commits of several consecutive blocks — all signed by
+    the SAME validator set — in one device batch. `items` is a list of
+    (block_id, height, commit). Returns a list of per-block Exception
+    or None, mirroring VerifyCommitLight's accept/reject per block
+    (reference types/validator_set.go:720, batched across blocks)."""
+    bv = BatchVerifier()
+    spans: list = []
+    results: list = [None] * len(items)
+    for i, (bid, height, commit) in enumerate(items):
+        try:
+            vals._check_commit_basics(bid, height, commit)
+            need = 2 * vals.total_voting_power()
+            tallied = 0
+            start = len(bv)
+            for idx, cs in enumerate(commit.signatures):
+                if not cs.for_block():
+                    continue
+                val = vals.validators[idx]
+                bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx),
+                       cs.signature)
+                tallied += val.voting_power
+                if 3 * tallied > need:
+                    break
+            if 3 * tallied <= need:
+                raise VerificationError(
+                    f"insufficient voting power at height {height}")
+            spans.append((i, start, len(bv)))
+        except Exception as e:
+            results[i] = e
+    if len(bv):
+        ok, verdicts = bv.verify()
+        for i, start, end in spans:
+            if not ok and not bool(verdicts[start:end].all()):
+                results[i] = VerificationError(
+                    f"invalid commit signature(s) for height "
+                    f"{items[i][1]}")
+    return results
+
+
+class BlockchainReactor(Reactor):
+    def __init__(self, state, block_exec, block_store,
+                 fast_sync: bool, consensus_reactor=None):
+        super().__init__("blockchain")
+        self.state = state
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.fast_sync = fast_sync
+        self.consensus_reactor = consensus_reactor
+        self.pool = BlockPool(block_store.height + 1
+                              if block_store.height else
+                              state.last_block_height + 1)
+        self._task: asyncio.Task | None = None
+        self.synced = asyncio.Event()
+        if not fast_sync:
+            self.synced.set()
+        self.blocks_synced = 0
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [ChannelDescriptor(id=BLOCKCHAIN_CHANNEL, priority=10,
+                                  send_queue_capacity=1000,
+                                  recv_message_capacity=10_485_760 + 1024,
+                                  name="blockchain")]
+
+    async def start(self) -> None:
+        if self.fast_sync:
+            self._task = asyncio.get_running_loop().create_task(
+                self._pool_routine(), name="blockchain-pool")
+
+    async def switch_to_fast_sync(self, state) -> None:
+        """Statesync → fastsync handoff (reference node.go:132)."""
+        self.state = state
+        self.fast_sync = True
+        self.synced.clear()
+        self.pool = BlockPool(state.last_block_height + 1)
+        await self.start()
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    # -- p2p --
+
+    def _our_status(self) -> bytes:
+        return encode_bc_msg(StatusResponseMessage(
+            height=self.block_store.height, base=self.block_store.base))
+
+    async def add_peer(self, peer) -> None:
+        peer.try_send(BLOCKCHAIN_CHANNEL, self._our_status())
+
+    async def remove_peer(self, peer, reason) -> None:
+        self.pool.remove_peer(peer.id)
+
+    async def receive(self, chan_id: int, peer, msgb: bytes) -> None:
+        msg = decode_bc_msg(msgb)
+        if isinstance(msg, BlockRequestMessage):
+            block = self.block_store.load_block(msg.height)
+            if block is not None:
+                await peer.send(BLOCKCHAIN_CHANNEL, encode_bc_msg(
+                    BlockResponseMessage(block)))
+            else:
+                await peer.send(BLOCKCHAIN_CHANNEL, encode_bc_msg(
+                    NoBlockResponseMessage(msg.height)))
+        elif isinstance(msg, StatusRequestMessage):
+            peer.try_send(BLOCKCHAIN_CHANNEL, self._our_status())
+        elif isinstance(msg, StatusResponseMessage):
+            self.pool.set_peer_range(peer.id, msg.base, msg.height)
+        elif isinstance(msg, NoBlockResponseMessage):
+            self.pool.no_block(peer.id, msg.height)
+        elif isinstance(msg, BlockResponseMessage):
+            self.pool.add_block(peer.id, msg.block, len(msgb))
+        else:
+            raise ValueError(f"unknown blockchain msg {type(msg)}")
+
+    # -- sync driver --
+
+    async def _pool_routine(self) -> None:
+        last_status = 0.0
+        last_switch_check = 0.0
+        try:
+            while True:
+                now = time.monotonic()
+                # expire slow/dead peers
+                for pid in self.pool.tick(now):
+                    self.pool.remove_peer(pid)
+                    sw = self.switch
+                    if sw is not None and pid in sw.peers:
+                        sw._on_peer_error(sw.peers[pid],
+                                          RuntimeError("fast-sync timeout"))
+                # issue new requests
+                sw = self.switch
+                if sw is not None:
+                    for pid, height in self.pool.make_next_requests(now):
+                        peer = sw.peers.get(pid)
+                        if peer is None:
+                            self.pool.remove_peer(pid)
+                            continue
+                        peer.try_send(BLOCKCHAIN_CHANNEL, encode_bc_msg(
+                            BlockRequestMessage(height)))
+                # periodic status poll
+                if now - last_status > STATUS_UPDATE_INTERVAL or \
+                        not self.pool.peers:
+                    last_status = now
+                    if sw is not None:
+                        sw.broadcast(BLOCKCHAIN_CHANNEL, encode_bc_msg(
+                            StatusRequestMessage()))
+                # drain what we can
+                while await self._try_sync():
+                    pass
+                # caught up?
+                if now - last_switch_check > SWITCH_TO_CONSENSUS_INTERVAL:
+                    last_switch_check = now
+                    if self.pool.peers and self.pool.is_caught_up():
+                        logger.info("fast sync complete at height %d "
+                                    "(%d blocks)", self.pool.height - 1,
+                                    self.blocks_synced)
+                        self.synced.set()
+                        if self.consensus_reactor is not None:
+                            await self.consensus_reactor.\
+                                switch_to_consensus(self.state)
+                        return
+                await asyncio.sleep(TRY_SYNC_INTERVAL)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("fast-sync pool routine died")
+
+    async def _try_sync(self) -> bool:
+        """Verify+apply a window of contiguous fetched blocks. Block i
+        is verified with block i+1's LastCommit, so with W+1 buffered
+        blocks, W are verifiable — in one signature batch when the
+        validator set is stable (the overwhelmingly common case)."""
+        blocks = self.pool.peek_blocks(BATCH_WINDOW + 1)
+        if len(blocks) < 2:
+            return False
+        vals = self.state.validators
+        chain_id = self.state.chain_id
+        items = []
+        for i in range(len(blocks) - 1):
+            first, second = blocks[i], blocks[i + 1]
+            parts = first.make_part_set()
+            bid = BlockID(first.hash(), parts.header())
+            items.append((bid, first.header.height, second.last_commit))
+        results = _batch_verify_window(vals, chain_id, items)
+
+        applied = 0
+        assumed_vals_hash = vals.hash()
+        for i, err in enumerate(results):
+            if err is not None:
+                peer_id = self.pool.redo_request(items[i][1])
+                logger.warning("block %d failed verification (%s); "
+                               "banning peer %s", items[i][1], err, peer_id)
+                sw = self.switch
+                if sw is not None and peer_id in sw.peers:
+                    sw._on_peer_error(sw.peers[peer_id],
+                                      RuntimeError(f"bad block: {err}"))
+                break
+            first = blocks[i]
+            bid = items[i][0]
+            parts = first.make_part_set()
+            self.pool.pop_request()
+            self.block_store.save_block(first, parts, blocks[i + 1].last_commit)
+            self.state, _ = await self.block_exec.apply_block(
+                self.state, bid, first)
+            self.blocks_synced += 1
+            applied += 1
+            if self.state.validators.hash() != assumed_vals_hash:
+                # validator set changed mid-window: the remaining
+                # verdicts were computed against the wrong set — leave
+                # those blocks buffered for re-verification next pass
+                break
+        return applied > 0

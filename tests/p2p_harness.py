@@ -1,0 +1,110 @@
+"""Shared multi-node p2p test harness: full validator nodes (stores +
+app + consensus + reactors) wired over real TCP sockets — the
+reference consensus/common_test.go + e2e-lite analogue."""
+
+from __future__ import annotations
+
+from tendermint_tpu.abci.client import ClientCreator
+from tendermint_tpu.abci.kvstore import PersistentKVStoreApp
+from tendermint_tpu.blockchain.reactor import BlockchainReactor
+from tendermint_tpu.config import fast_consensus_config
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.consensus.replay import handshake_and_load_state
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.p2p.key import NodeKey
+from tendermint_tpu.p2p.node_info import NodeInfo
+from tendermint_tpu.p2p.switch import Switch
+from tendermint_tpu.p2p.transport import Transport
+from tendermint_tpu.proxy import AppConns
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.store import Store
+from tendermint_tpu.store import BlockStore
+from tendermint_tpu.types.events import EventBus
+
+
+class P2PNode:
+    """A node wired through a real Switch; consensus reactor always,
+    blockchain reactor optional (fast_sync)."""
+
+    def __init__(self, gdoc, pv, moniker, fast_sync=False):
+        self.gdoc = gdoc
+        self.pv = pv
+        self.moniker = moniker
+        self.fast_sync = fast_sync
+        self.node_key = NodeKey.generate()
+        self.switch = None
+        self.cs = None
+        self.bc_reactor = None
+
+    async def start(self, wait_sync=None):
+        if wait_sync is None:
+            wait_sync = self.fast_sync
+        self.app = PersistentKVStoreApp(MemDB())
+        self.conns = AppConns(ClientCreator(app=self.app))
+        await self.conns.start()
+        state_store = Store(MemDB())
+        self.block_store = BlockStore(MemDB())
+        state = await handshake_and_load_state(
+            None, state_store, self.block_store, self.gdoc, self.conns)
+        executor = BlockExecutor(state_store, self.conns.consensus,
+                                 event_bus=EventBus())
+        self.cs = ConsensusState(fast_consensus_config(), state, executor,
+                                 self.block_store)
+        if self.pv is not None:
+            self.cs.set_priv_validator(self.pv)
+        self.reactor = ConsensusReactor(self.cs, wait_sync=wait_sync,
+                                        gossip_sleep=0.02)
+        self.bc_reactor = BlockchainReactor(
+            state, executor, self.block_store, fast_sync=self.fast_sync,
+            consensus_reactor=self.reactor)
+
+        holder = {}
+
+        def ni():
+            t = holder["transport"]
+            addr = t.listen_addr if t._server else ""
+            return NodeInfo(node_id=self.node_key.id, listen_addr=addr,
+                            network=self.gdoc.chain_id,
+                            moniker=self.moniker,
+                            channels=bytes([0x20, 0x21, 0x22, 0x23, 0x40]))
+
+        transport = Transport(self.node_key, ni)
+        holder["transport"] = transport
+        self.switch = Switch(transport, ni)
+        self.switch.add_reactor("consensus", self.reactor)
+        self.switch.add_reactor("blockchain", self.bc_reactor)
+        await transport.listen("127.0.0.1", 0)
+        await self.switch.start()
+        await self.bc_reactor.start()
+        if not wait_sync:
+            await self.cs.start()
+
+    @property
+    def addr(self):
+        return f"{self.node_key.id}@{self.switch.transport.listen_addr}"
+
+    async def dial(self, other):
+        await self.switch.dial_peer(other.addr)
+
+    async def stop(self):
+        if self.cs is not None and self.cs.is_running:
+            await self.cs.stop()
+        if self.bc_reactor is not None:
+            await self.bc_reactor.stop()
+        await self.reactor.stop()
+        if self.switch is not None:
+            await self.switch.stop()
+        await self.conns.stop()
+
+
+async def make_net(n, wait_sync_last=False):
+    from helpers import make_genesis
+
+    gdoc, pvs = make_genesis(n)
+    nodes = [P2PNode(gdoc, pvs[i], f"val{i}") for i in range(n)]
+    for i, node in enumerate(nodes):
+        await node.start(wait_sync=(wait_sync_last and i == n - 1))
+    for i in range(n):
+        await nodes[i].dial(nodes[(i + 1) % n])
+    return nodes

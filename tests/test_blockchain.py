@@ -1,0 +1,179 @@
+"""Fast sync: pool state machine, wire codec, cross-block batch
+verification, and an end-to-end catch-up over TCP (reference:
+blockchain/v0/pool_test.go + reactor_test.go)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.blockchain.msgs import (
+    BlockRequestMessage, BlockResponseMessage, NoBlockResponseMessage,
+    StatusRequestMessage, StatusResponseMessage, decode_bc_msg,
+    encode_bc_msg,
+)
+from tendermint_tpu.blockchain.pool import (
+    BlockPool, MAX_PENDING_PER_PEER, REQUEST_TIMEOUT,
+)
+from tendermint_tpu.blockchain.reactor import _batch_verify_window
+from tendermint_tpu.types.block import BlockID
+from tendermint_tpu.types.validator_set import VerificationError
+
+from helpers import make_genesis_state_and_pvs, sign_commit
+from p2p_harness import P2PNode, make_net
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class FakeBlock:
+    def __init__(self, height):
+        self.header = type("H", (), {"height": height})()
+
+
+# --- pool ---------------------------------------------------------------------
+
+def test_pool_assigns_requests_and_orders_blocks():
+    pool = BlockPool(1)
+    pool.set_peer_range("p1", 1, 50)
+    pool.set_peer_range("p2", 1, 50)
+    reqs = pool.make_next_requests(now=0.0)
+    heights = sorted(h for _, h in reqs)
+    assert heights[0] == 1
+    assert len(reqs) == 2 * MAX_PENDING_PER_PEER  # both peers saturated
+    by_height = dict((h, p) for p, h in reqs)
+    # blocks from the wrong peer are refused
+    wrong = "p1" if by_height[1] == "p2" else "p2"
+    assert not pool.add_block(wrong, FakeBlock(1), 100)
+    assert pool.add_block(by_height[1], FakeBlock(1), 100)
+    assert pool.add_block(by_height[2], FakeBlock(2), 100)
+    assert [b.header.height for b in pool.peek_blocks(5)] == [1, 2]
+    pool.pop_request()
+    assert pool.height == 2
+    assert [b.header.height for b in pool.peek_blocks(5)] == [2]
+
+
+def test_pool_timeout_drops_peer():
+    pool = BlockPool(1)
+    pool.set_peer_range("p1", 1, 10)
+    pool.make_next_requests(now=0.0)
+    assert pool.tick(now=1.0) == []
+    bad = pool.tick(now=REQUEST_TIMEOUT + 1)
+    assert bad == ["p1"]
+    redo = pool.remove_peer("p1")
+    assert 1 in redo
+    # heights become assignable to another peer
+    pool.set_peer_range("p2", 1, 10)
+    reqs = pool.make_next_requests(now=20.0)
+    assert ("p2", 1) in reqs
+
+
+def test_pool_no_block_shrinks_peer():
+    pool = BlockPool(5)
+    pool.set_peer_range("p1", 1, 10)
+    pool.make_next_requests(now=0.0)
+    pool.no_block("p1", 7)
+    assert pool.peers["p1"].height == 6
+    assert 7 not in pool.requests
+
+
+def test_pool_redo_bans_lying_peer():
+    pool = BlockPool(1)
+    pool.set_peer_range("p1", 1, 10)
+    reqs = pool.make_next_requests(now=0.0)
+    for _, h in reqs:
+        pool.add_block("p1", FakeBlock(h), 10)
+    assert pool.redo_request(1) == "p1"
+    assert "p1" not in pool.peers
+    assert not pool.requests  # all its buffered blocks dropped
+    pool.set_peer_range("p1", 1, 10)  # banned: re-add refused
+    assert "p1" not in pool.peers
+
+
+def test_pool_caught_up():
+    pool = BlockPool(10)
+    assert not pool.is_caught_up()  # no peers
+    pool.set_peer_range("p1", 1, 9)
+    assert pool.is_caught_up()
+    pool.set_peer_range("p2", 1, 30)
+    assert not pool.is_caught_up()
+
+
+# --- codec --------------------------------------------------------------------
+
+def test_msgs_roundtrip():
+    for msg in (BlockRequestMessage(7), NoBlockResponseMessage(9),
+                StatusRequestMessage(), StatusResponseMessage(42, 3)):
+        out = decode_bc_msg(encode_bc_msg(msg))
+        assert out == msg
+    with pytest.raises(ValueError):
+        decode_bc_msg(b"")
+    with pytest.raises(ValueError):
+        decode_bc_msg(bytes([99]))
+    with pytest.raises(ValueError):
+        decode_bc_msg(encode_bc_msg(BlockRequestMessage(0)))
+
+
+# --- batch verification -------------------------------------------------------
+
+def _make_commit_chain(n_blocks):
+    state, pvs = make_genesis_state_and_pvs(4)
+    vals = state.validators
+    items = []
+    from tendermint_tpu.types.block import PartSetHeader
+    for h in range(1, n_blocks + 1):
+        bid = BlockID(bytes([h]) * 32, PartSetHeader(1, bytes([h]) * 32))
+        commit = sign_commit(vals, pvs, state.chain_id, h, 0, bid,
+                             1_700_000_000 * 10**9 + h)
+        items.append((bid, h, commit))
+    return vals, state.chain_id, items
+
+
+def test_batch_verify_window_accepts_valid_chain():
+    vals, chain_id, items = _make_commit_chain(5)
+    results = _batch_verify_window(vals, chain_id, items)
+    assert results == [None] * 5
+
+
+def test_batch_verify_window_pinpoints_bad_block():
+    vals, chain_id, items = _make_commit_chain(5)
+    bad = items[2][2]
+    bad.signatures[0].signature = b"\x00" * 64
+    results = _batch_verify_window(vals, chain_id, items)
+    assert results[0] is None and results[1] is None
+    assert isinstance(results[2], VerificationError)
+    assert results[3] is None and results[4] is None
+
+
+# --- end-to-end fast sync over TCP -------------------------------------------
+
+def test_fastsync_catches_up_then_joins_consensus():
+    async def go():
+        from helpers import make_genesis
+
+        gdoc, pvs = make_genesis(1)
+        a = P2PNode(gdoc, pvs[0], "val0")
+        await a.start()
+        try:
+            await a.cs.wait_for_height(6, timeout=60)
+            # b holds no validator key: it must sync purely from a
+            b = P2PNode(gdoc, None, "syncer", fast_sync=True)
+            await b.start()
+            try:
+                await b.dial(a)
+                await asyncio.wait_for(b.bc_reactor.synced.wait(), 60)
+                assert b.bc_reactor.blocks_synced >= 4
+                assert b.block_store.height >= 5
+                # blocks match a's chain
+                h = b.block_store.height
+                assert (b.block_store.load_block_meta(h).block_id.hash ==
+                        a.block_store.load_block_meta(h).block_id.hash)
+                # after handoff, consensus gossip keeps b at the head
+                target = a.cs.rs.height + 2
+                await b.cs.wait_for_height(target, timeout=60)
+            finally:
+                await b.stop()
+        finally:
+            await a.stop()
+
+    run(go())

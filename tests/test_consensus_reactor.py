@@ -4,101 +4,11 @@ analogue, but over actual TCP sockets instead of in-memory conns)."""
 
 import asyncio
 
-from tendermint_tpu.abci.client import ClientCreator
-from tendermint_tpu.abci.kvstore import PersistentKVStoreApp
-from tendermint_tpu.config import fast_consensus_config
-from tendermint_tpu.consensus.reactor import ConsensusReactor
-from tendermint_tpu.consensus.state import ConsensusState
-from tendermint_tpu.consensus.replay import handshake_and_load_state
-from tendermint_tpu.libs.db import MemDB
-from tendermint_tpu.p2p.key import NodeKey
-from tendermint_tpu.p2p.node_info import NodeInfo
-from tendermint_tpu.p2p.switch import Switch
-from tendermint_tpu.p2p.transport import Transport
-from tendermint_tpu.proxy import AppConns
-from tendermint_tpu.state.execution import BlockExecutor
-from tendermint_tpu.state.store import Store
-from tendermint_tpu.store import BlockStore
-from tendermint_tpu.types.events import EventBus
-
-from helpers import deterministic_pv, make_genesis
+from p2p_harness import make_net
 
 
 def run(coro):
     return asyncio.run(coro)
-
-
-class P2PNode:
-    """A validator node wired through a real Switch + ConsensusReactor."""
-
-    def __init__(self, gdoc, pv, moniker):
-        self.gdoc = gdoc
-        self.pv = pv
-        self.moniker = moniker
-        self.node_key = NodeKey.generate()
-        self.switch = None
-        self.cs = None
-
-    async def start(self, wait_sync=False):
-        self.app = PersistentKVStoreApp(MemDB())
-        self.conns = AppConns(ClientCreator(app=self.app))
-        await self.conns.start()
-        state_store = Store(MemDB())
-        self.block_store = BlockStore(MemDB())
-        state = await handshake_and_load_state(
-            None, state_store, self.block_store, self.gdoc, self.conns)
-        executor = BlockExecutor(state_store, self.conns.consensus,
-                                 event_bus=EventBus())
-        self.cs = ConsensusState(fast_consensus_config(), state, executor,
-                                 self.block_store)
-        self.cs.set_priv_validator(self.pv)
-        self.reactor = ConsensusReactor(self.cs, wait_sync=wait_sync,
-                                        gossip_sleep=0.02)
-
-        holder = {}
-
-        def ni():
-            t = holder["transport"]
-            addr = t.listen_addr if t._server else ""
-            return NodeInfo(node_id=self.node_key.id, listen_addr=addr,
-                            network=self.gdoc.chain_id,
-                            moniker=self.moniker,
-                            channels=bytes([0x20, 0x21, 0x22, 0x23]))
-
-        transport = Transport(self.node_key, ni)
-        holder["transport"] = transport
-        self.switch = Switch(transport, ni)
-        self.switch.add_reactor("consensus", self.reactor)
-        await transport.listen("127.0.0.1", 0)
-        await self.switch.start()
-        if not wait_sync:
-            await self.cs.start()
-
-    @property
-    def addr(self):
-        return f"{self.node_key.id}@{self.switch.transport.listen_addr}"
-
-    async def dial(self, other):
-        await self.switch.dial_peer(other.addr)
-
-    async def stop(self):
-        if self.cs is not None and self.cs.is_running:
-            await self.cs.stop()
-        await self.reactor.stop()
-        if self.switch is not None:
-            await self.switch.stop()
-        await self.conns.stop()
-
-
-async def make_net(n, wait_sync_last=False):
-    gdoc, pvs = make_genesis(n)
-    nodes = [P2PNode(gdoc, pvs[i], f"val{i}") for i in range(n)]
-    for i, node in enumerate(nodes):
-        await node.start(wait_sync=(wait_sync_last and i == n - 1))
-    # connect in a ring + one chord so gossip has multiple paths
-    for i in range(n):
-        await nodes[i].dial(nodes[(i + 1) % n])
-    return nodes
 
 
 def test_4val_net_commits_blocks_over_tcp():
