@@ -277,6 +277,9 @@ class ConsensusReactor(Reactor):
     async def add_peer(self, peer) -> None:
         ps = PeerState(peer)
         self.peer_states[peer.id] = ps
+        # other reactors (evidence, mempool) read the peer's consensus
+        # height from here (reference: types.PeerStateKey on peer kv)
+        peer.set("consensus_peer_state", ps)
         # tell the new peer where we are (reference sendNewRoundStepMessage)
         peer.try_send(STATE_CHANNEL, m.encode_consensus_msg(
             _new_round_step_msg(self.cs.rs)))

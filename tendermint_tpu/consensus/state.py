@@ -664,13 +664,21 @@ class ConsensusState(Service):
                 )
                 return False
             if self.evpool is not None and e.existing is not None:
+                from ..state import median_time
                 from ..types.evidence import DuplicateVoteEvidence
 
+                # The evidence timestamp must equal the header time of
+                # the block at the evidence height — which is the
+                # BFT-median of LastCommit (reference state.go:1868-76);
+                # peers' pools reject any other timestamp.
+                if vote.height == self.state.initial_height or \
+                        self.rs.last_commit is None:
+                    ts = self.state.last_block_time
+                else:
+                    ts = median_time(self.rs.last_commit.make_commit(),
+                                     self.rs.last_validators)
                 ev = DuplicateVoteEvidence.from_votes(
-                    e.existing, vote, self.state.last_block_time,
-                    self.rs.last_validators
-                    if vote.height == self.state.last_block_height
-                    else self.rs.validators,
+                    e.existing, vote, ts, self.rs.validators,
                 )
                 self.evpool.add_evidence_from_consensus(ev)
             return False

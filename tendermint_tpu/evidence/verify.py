@@ -1,0 +1,87 @@
+"""Evidence verification (reference: evidence/verify.go).
+
+DuplicateVoteEvidence: both conflicting votes' signatures verify as
+one device batch (reference does two sequential verifies,
+verify.go:165-225)."""
+
+from __future__ import annotations
+
+from ..crypto.batch import BatchVerifier
+from ..types.evidence import DuplicateVoteEvidence, Evidence
+
+
+class EvidenceError(Exception):
+    pass
+
+
+def verify_evidence(ev: Evidence, state, state_store, block_store) -> None:
+    """Full verification against committed chain state
+    (reference: evidence/verify.go:25 Verify + prepare checks)."""
+    height = ev.height()
+    header_time = _committed_block_time(block_store, height)
+
+    # expiry relative to consensus params (reference verify.go:33-47:
+    # expired only when BOTH height- and time-age are exceeded)
+    p = state.consensus_params.evidence
+    age_blocks = state.last_block_height - height
+    age_ns = state.last_block_time - header_time
+    if age_blocks > p.max_age_num_blocks and age_ns > p.max_age_duration_ns:
+        raise EvidenceError(
+            f"evidence from height {height} is too old "
+            f"({age_blocks} blocks / {age_ns / 1e9:.0f}s)")
+
+    if isinstance(ev, DuplicateVoteEvidence):
+        vals = state_store.load_validators(height)
+        if vals is None:
+            raise EvidenceError(f"no validator set at height {height}")
+        verify_duplicate_vote(ev, state.chain_id, vals, header_time)
+    else:
+        raise EvidenceError(f"unknown evidence type {type(ev).__name__}")
+
+
+def _committed_block_time(block_store, height: int) -> int:
+    meta = block_store.load_block_meta(height)
+    if meta is None:
+        raise EvidenceError(f"no committed block at evidence height {height}")
+    return meta.header.time
+
+
+def verify_duplicate_vote(ev: DuplicateVoteEvidence, chain_id: str,
+                          vals, header_time: int) -> None:
+    """reference: evidence/verify.go:165 VerifyDuplicateVote."""
+    a, b = ev.vote_a, ev.vote_b
+
+    if a.height != b.height or a.round != b.round or a.type != b.type:
+        raise EvidenceError("votes are from different H/R/S")
+    if a.validator_address != b.validator_address:
+        raise EvidenceError("votes are from different validators")
+    if a.block_id == b.block_id:
+        raise EvidenceError("votes are for the same block id")
+    from ..types.vote_set import _block_key
+    if not _block_key(a.block_id) < _block_key(b.block_id):
+        raise EvidenceError("votes not in canonical order")
+
+    _, val = vals.get_by_address(a.validator_address)
+    if val is None:
+        raise EvidenceError(
+            f"validator {a.validator_address.hex()} not in set at "
+            f"height {a.height}")
+
+    # recorded powers must match the valset (they feed ABCI punishment)
+    if ev.validator_power != val.voting_power:
+        raise EvidenceError(
+            f"validator power mismatch: {ev.validator_power} != "
+            f"{val.voting_power}")
+    if ev.total_voting_power != vals.total_voting_power():
+        raise EvidenceError("total voting power mismatch")
+    if ev.timestamp != header_time:
+        raise EvidenceError(
+            f"evidence time {ev.timestamp} != block time {header_time}")
+
+    bv = BatchVerifier()
+    bv.add(val.pub_key, a.sign_bytes(chain_id), a.signature)
+    bv.add(val.pub_key, b.sign_bytes(chain_id), b.signature)
+    ok, verdicts = bv.verify()
+    if not ok:
+        which = "A" if not verdicts[0] else "B"
+        raise EvidenceError(f"invalid signature on vote {which}")

@@ -69,6 +69,20 @@ class DuplicateVoteEvidence(Evidence):
     def hash(self) -> bytes:
         return tmhash.sum256(self.to_bytes())
 
+    def to_abci(self) -> list:
+        """BeginBlock byzantine_validators entries
+        (reference: types/evidence.go ABCI())."""
+        from ..abci.types import Misbehavior
+
+        return [Misbehavior(
+            type="DUPLICATE_VOTE",
+            validator_address=self.vote_a.validator_address,
+            validator_power=self.validator_power,
+            height=self.vote_a.height,
+            time=self.timestamp,
+            total_voting_power=self.total_voting_power,
+        )]
+
     def validate_basic(self) -> None:
         if self.vote_a is None or self.vote_b is None:
             raise ValueError("missing votes")

@@ -7,6 +7,8 @@ from __future__ import annotations
 from tendermint_tpu.abci.client import ClientCreator
 from tendermint_tpu.abci.kvstore import PersistentKVStoreApp
 from tendermint_tpu.blockchain.reactor import BlockchainReactor
+from tendermint_tpu.evidence import Pool as EvidencePool
+from tendermint_tpu.evidence.reactor import EvidenceReactor
 from tendermint_tpu.config import fast_consensus_config
 from tendermint_tpu.consensus.reactor import ConsensusReactor
 from tendermint_tpu.consensus.replay import handshake_and_load_state
@@ -47,10 +49,12 @@ class P2PNode:
         self.block_store = BlockStore(MemDB())
         state = await handshake_and_load_state(
             None, state_store, self.block_store, self.gdoc, self.conns)
+        self.evpool = EvidencePool(MemDB(), state_store, self.block_store)
         executor = BlockExecutor(state_store, self.conns.consensus,
-                                 event_bus=EventBus())
+                                 event_bus=EventBus(),
+                                 evidence_pool=self.evpool)
         self.cs = ConsensusState(fast_consensus_config(), state, executor,
-                                 self.block_store)
+                                 self.block_store, evpool=self.evpool)
         if self.pv is not None:
             self.cs.set_priv_validator(self.pv)
         self.reactor = ConsensusReactor(self.cs, wait_sync=wait_sync,
@@ -58,6 +62,7 @@ class P2PNode:
         self.bc_reactor = BlockchainReactor(
             state, executor, self.block_store, fast_sync=self.fast_sync,
             consensus_reactor=self.reactor)
+        self.ev_reactor = EvidenceReactor(self.evpool)
 
         holder = {}
 
@@ -67,13 +72,15 @@ class P2PNode:
             return NodeInfo(node_id=self.node_key.id, listen_addr=addr,
                             network=self.gdoc.chain_id,
                             moniker=self.moniker,
-                            channels=bytes([0x20, 0x21, 0x22, 0x23, 0x40]))
+                            channels=bytes([0x20, 0x21, 0x22, 0x23,
+                                            0x38, 0x40]))
 
         transport = Transport(self.node_key, ni)
         holder["transport"] = transport
         self.switch = Switch(transport, ni)
         self.switch.add_reactor("consensus", self.reactor)
         self.switch.add_reactor("blockchain", self.bc_reactor)
+        self.switch.add_reactor("evidence", self.ev_reactor)
         await transport.listen("127.0.0.1", 0)
         await self.switch.start()
         await self.bc_reactor.start()
@@ -92,6 +99,7 @@ class P2PNode:
             await self.cs.stop()
         if self.bc_reactor is not None:
             await self.bc_reactor.stop()
+        await self.ev_reactor.stop()
         await self.reactor.stop()
         if self.switch is not None:
             await self.switch.stop()
