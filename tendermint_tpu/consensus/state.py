@@ -335,7 +335,7 @@ class ConsensusState(Service):
         ))
 
         if self._is_proposer() and self.priv_validator is not None:
-            self._decide_proposal(height, round_)
+            await self._decide_proposal(height, round_)
 
         if rs.proposal_complete():
             await self._enter_prevote(height, round_)
@@ -348,7 +348,7 @@ class ConsensusState(Service):
             == self.priv_validator_address
         )
 
-    def _decide_proposal(self, height: int, round_: int) -> None:
+    async def _decide_proposal(self, height: int, round_: int) -> None:
         """reference defaultDecideProposal (state.go:1063)."""
         rs = self.rs
         if rs.valid_block is not None:
@@ -373,7 +373,10 @@ class ConsensusState(Service):
             block_id=block_id, timestamp=_time.time_ns(),
         )
         try:
-            self.priv_validator.sign_proposal(self.state.chain_id, proposal)
+            res = self.priv_validator.sign_proposal(self.state.chain_id,
+                                                    proposal)
+            if asyncio.iscoroutine(res):
+                await res  # remote signer round-trip
         except Exception as e:
             self.logger.error("failed to sign proposal: %r", e)
             return
@@ -413,20 +416,20 @@ class ConsensusState(Service):
         self._new_step(RoundStep.PREVOTE)
         # reference defaultDoPrevote (state.go:1229)
         if rs.locked_block is not None:
-            self._sign_add_vote(VoteType.PREVOTE, rs.locked_block.hash(),
+            await self._sign_add_vote(VoteType.PREVOTE, rs.locked_block.hash(),
                                 rs.locked_block_parts.header())
         elif rs.proposal_block is None:
-            self._sign_add_vote(VoteType.PREVOTE, b"", None)
+            await self._sign_add_vote(VoteType.PREVOTE, b"", None)
         else:
             try:
                 self.block_exec.validate_block(self.state, rs.proposal_block)
-                self._sign_add_vote(
+                await self._sign_add_vote(
                     VoteType.PREVOTE, rs.proposal_block.hash(),
                     rs.proposal_block_parts.header(),
                 )
             except Exception as e:
                 self.logger.warning("invalid proposal block: %r", e)
-                self._sign_add_vote(VoteType.PREVOTE, b"", None)
+                await self._sign_add_vote(VoteType.PREVOTE, b"", None)
 
     async def _enter_prevote_wait(self, height: int, round_: int) -> None:
         rs = self.rs
@@ -453,7 +456,7 @@ class ConsensusState(Service):
 
         if not has_maj:
             # no polka: precommit nil
-            self._sign_add_vote(VoteType.PRECOMMIT, b"", None)
+            await self._sign_add_vote(VoteType.PRECOMMIT, b"", None)
             return
 
         if self.event_bus is not None:
@@ -466,13 +469,13 @@ class ConsensusState(Service):
             rs.locked_round = -1
             rs.locked_block = None
             rs.locked_block_parts = None
-            self._sign_add_vote(VoteType.PRECOMMIT, b"", None)
+            await self._sign_add_vote(VoteType.PRECOMMIT, b"", None)
             return
 
         # +2/3 for a block
         if rs.locked_block is not None and rs.locked_block.hash() == bid.hash:
             rs.locked_round = round_  # re-lock at this round
-            self._sign_add_vote(VoteType.PRECOMMIT, bid.hash,
+            await self._sign_add_vote(VoteType.PRECOMMIT, bid.hash,
                                 bid.part_set_header)
             return
         if rs.proposal_block is not None and rs.proposal_block.hash() == bid.hash:
@@ -480,7 +483,7 @@ class ConsensusState(Service):
                 self.block_exec.validate_block(self.state, rs.proposal_block)
             except Exception as e:
                 self.logger.error("polka for invalid block: %r", e)
-                self._sign_add_vote(VoteType.PRECOMMIT, b"", None)
+                await self._sign_add_vote(VoteType.PRECOMMIT, b"", None)
                 return
             rs.locked_round = round_
             rs.locked_block = rs.proposal_block
@@ -489,7 +492,7 @@ class ConsensusState(Service):
                 self.event_bus.publish_lock(EventDataRoundState(
                     height, round_, "Lock"
                 ))
-            self._sign_add_vote(VoteType.PRECOMMIT, bid.hash,
+            await self._sign_add_vote(VoteType.PRECOMMIT, bid.hash,
                                 bid.part_set_header)
             return
 
@@ -504,7 +507,7 @@ class ConsensusState(Service):
             rs.proposal_block_parts = PartSet(
                 bid.part_set_header.total, bid.part_set_header.hash
             )
-        self._sign_add_vote(VoteType.PRECOMMIT, b"", None)
+        await self._sign_add_vote(VoteType.PRECOMMIT, b"", None)
 
     async def _enter_precommit_wait(self, height: int, round_: int) -> None:
         rs = self.rs
@@ -668,17 +671,22 @@ class ConsensusState(Service):
                 from ..types.evidence import DuplicateVoteEvidence
 
                 # The evidence timestamp must equal the header time of
-                # the block at the evidence height — which is the
-                # BFT-median of LastCommit (reference state.go:1868-76);
-                # peers' pools reject any other timestamp.
-                if vote.height == self.state.initial_height or \
+                # the block at the EVIDENCE height — peers' pools reject
+                # any other timestamp (reference state.go:1868-76 uses
+                # the LastCommit median; we additionally handle the
+                # late-vote case, where the conflicting vote is for the
+                # already-committed height and that block's time is
+                # simply state.last_block_time).
+                if vote.height == self.state.last_block_height or \
                         self.rs.last_commit is None:
                     ts = self.state.last_block_time
+                    vals = self.rs.last_validators
                 else:
                     ts = median_time(self.rs.last_commit.make_commit(),
                                      self.rs.last_validators)
+                    vals = self.rs.validators
                 ev = DuplicateVoteEvidence.from_votes(
-                    e.existing, vote, ts, self.rs.validators,
+                    e.existing, vote, ts, vals,
                 )
                 self.evpool.add_evidence_from_consensus(ev)
             return False
@@ -779,8 +787,8 @@ class ConsensusState(Service):
             await self._enter_new_round(rs.height, vote.round)
             await self._enter_precommit_wait(rs.height, vote.round)
 
-    def _sign_add_vote(self, type_: VoteType, hash_: bytes,
-                       part_set_header) -> Vote | None:
+    async def _sign_add_vote(self, type_: VoteType, hash_: bytes,
+                             part_set_header) -> Vote | None:
         """reference signAddVote (state.go:2139)."""
         if self.priv_validator is None or self.rs.validators is None:
             return None
@@ -800,7 +808,9 @@ class ConsensusState(Service):
             validator_index=idx,
         )
         try:
-            self.priv_validator.sign_vote(self.state.chain_id, vote)
+            res = self.priv_validator.sign_vote(self.state.chain_id, vote)
+            if asyncio.iscoroutine(res):
+                await res  # remote signer round-trip
         except Exception as e:
             self.logger.error("failed to sign vote: %r", e)
             return None

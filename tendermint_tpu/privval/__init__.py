@@ -1,0 +1,24 @@
+"""Validator signing sidecar (reference: privval/).
+
+`FilePV` persists the key and — critically — the last-sign state
+(height/round/step + signbytes + signature) BEFORE releasing any
+signature, so a crash-restart can never double-sign
+(reference: privval/file.go:151,316; CheckHRS :94).
+
+The remote signer lets the key live in a separate hardened process:
+`SignerServer` wraps a FilePV behind a socket; `SignerClient`
+implements `types.PrivValidator` over that socket so consensus can't
+tell the difference (reference: privval/signer_client.go:16,
+signer_listener_endpoint.go)."""
+
+from .file_pv import FilePV, LastSignState, RemoteSignError
+from .signer import (
+    SignerClient,
+    SignerServer,
+    serve_signer,
+)
+
+__all__ = [
+    "FilePV", "LastSignState", "RemoteSignError",
+    "SignerClient", "SignerServer", "serve_signer",
+]

@@ -1,0 +1,203 @@
+"""Remote signer over a socket (reference: privval/signer_client.go,
+signer_listener_endpoint.go, signer_server.go).
+
+Deployment model matches the reference's dialer mode: the SIGNER
+process (holding the key, wrapping a FilePV) dials the validator
+node's listen endpoint, so the key machine needs no open ports. The
+node side (`SignerClient`) accepts that connection and then issues
+sign requests over it; it implements `types.PrivValidator` with
+async sign methods the consensus state machine awaits.
+
+Frames: 4-byte big-endian length + JSON object. Requests carry
+canonical proto payloads hex-encoded (votes/proposals ride their own
+wire codecs, not ad-hoc JSON)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+
+from ..types.proposal import Proposal
+from ..types.vote import Vote
+from .file_pv import FilePV, RemoteSignError
+
+logger = logging.getLogger("privval.signer")
+
+_MAX_FRAME = 1 << 20
+
+
+async def _read_frame(reader) -> dict:
+    hdr = await reader.readexactly(4)
+    ln = int.from_bytes(hdr, "big")
+    if ln > _MAX_FRAME:
+        raise ValueError("signer frame too large")
+    return json.loads(await reader.readexactly(ln))
+
+
+def _write_frame(writer, obj: dict) -> None:
+    raw = json.dumps(obj).encode()
+    writer.write(len(raw).to_bytes(4, "big") + raw)
+
+
+class SignerServer:
+    """Runs NEXT TO THE KEY: wraps a FilePV and answers sign requests
+    arriving on its connection (reference: privval/signer_server.go)."""
+
+    def __init__(self, pv: FilePV, chain_id: str):
+        self.pv = pv
+        self.chain_id = chain_id
+
+    async def serve_connection(self, reader, writer) -> None:
+        try:
+            while True:
+                req = await _read_frame(reader)
+                _write_frame(writer, self._handle(req))
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    def _handle(self, req: dict) -> dict:
+        t = req.get("type")
+        try:
+            if t == "ping":
+                return {"type": "pong"}
+            if t == "pub_key":
+                pk = self.pv.get_pub_key()
+                return {"type": "pub_key", "pub_key": pk.bytes().hex()}
+            if t == "sign_vote":
+                if req.get("chain_id") != self.chain_id:
+                    raise RemoteSignError("chain id mismatch")
+                vote = Vote.from_bytes(bytes.fromhex(req["vote"]))
+                self.pv.sign_vote(self.chain_id, vote)
+                return {"type": "signed_vote",
+                        "vote": vote.to_bytes().hex()}
+            if t == "sign_proposal":
+                if req.get("chain_id") != self.chain_id:
+                    raise RemoteSignError("chain id mismatch")
+                prop = Proposal.from_bytes(bytes.fromhex(req["proposal"]))
+                self.pv.sign_proposal(self.chain_id, prop)
+                return {"type": "signed_proposal",
+                        "proposal": prop.to_bytes().hex()}
+            raise RemoteSignError(f"unknown request {t!r}")
+        except RemoteSignError as e:
+            return {"type": "error", "error": str(e)}
+        except Exception as e:  # malformed payloads must not kill the link
+            logger.exception("signer request failed")
+            return {"type": "error", "error": f"internal: {e}"}
+
+    async def dial_and_serve(self, host: str, port: int,
+                             retries: int = 10,
+                             retry_delay: float = 0.5) -> None:
+        """Dialer mode: connect OUT to the validator node
+        (reference: privval/socket_dialers.go)."""
+        for attempt in range(retries):
+            try:
+                reader, writer = await asyncio.open_connection(host, port)
+                await self.serve_connection(reader, writer)
+                return
+            except ConnectionError:
+                await asyncio.sleep(retry_delay * (attempt + 1))
+        raise ConnectionError(f"signer could not reach {host}:{port}")
+
+
+def serve_signer(pv: FilePV, chain_id: str, host: str = "127.0.0.1",
+                 port: int = 0):
+    """Listener-mode signer (for tests/tools): returns the asyncio
+    server; the validator's SignerClient dials it."""
+    server = SignerServer(pv, chain_id)
+    return asyncio.start_server(server.serve_connection, host, port)
+
+
+class SignerClient:
+    """Runs IN THE NODE: implements PrivValidator over the socket
+    (reference: privval/signer_client.go:16). One in-flight request at
+    a time (the consensus event loop is serialized anyway)."""
+
+    def __init__(self, chain_id: str, timeout: float = 5.0):
+        self.chain_id = chain_id
+        self.timeout = timeout
+        self._reader = None
+        self._writer = None
+        self._lock = asyncio.Lock()
+        self._pub_key = None
+
+    # -- connection management --
+
+    async def listen(self, host: str = "127.0.0.1", port: int = 0):
+        """Listener mode: wait for the signer process to dial us
+        (reference: SignerListenerEndpoint)."""
+        connected = asyncio.get_running_loop().create_future()
+
+        def on_conn(reader, writer):
+            if not connected.done():
+                connected.set_result((reader, writer))
+            else:
+                writer.close()
+
+        server = await asyncio.start_server(on_conn, host, port)
+        self._server = server
+        self._connected = connected
+        return server.sockets[0].getsockname()[1]
+
+    async def wait_connected(self) -> None:
+        self._reader, self._writer = await asyncio.wait_for(
+            self._connected, self.timeout)
+        # cache the pub key eagerly: get_pub_key must stay sync for the
+        # PrivValidator interface
+        resp = await self._call({"type": "pub_key"})
+        from ..crypto.ed25519 import Ed25519PubKey
+        self._pub_key = Ed25519PubKey(bytes.fromhex(resp["pub_key"]))
+
+    async def connect(self, reader, writer) -> None:
+        """Direct wiring (tests)."""
+        self._reader, self._writer = reader, writer
+        resp = await self._call({"type": "pub_key"})
+        from ..crypto.ed25519 import Ed25519PubKey
+        self._pub_key = Ed25519PubKey(bytes.fromhex(resp["pub_key"]))
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+        if getattr(self, "_server", None) is not None:
+            self._server.close()
+
+    async def _call(self, req: dict) -> dict:
+        if self._writer is None:
+            raise RemoteSignError("signer not connected")
+        async with self._lock:
+            _write_frame(self._writer, req)
+            await self._writer.drain()
+            resp = await asyncio.wait_for(_read_frame(self._reader),
+                                          self.timeout)
+        if resp.get("type") == "error":
+            raise RemoteSignError(resp.get("error", "unknown"))
+        return resp
+
+    async def ping(self) -> None:
+        await self._call({"type": "ping"})
+
+    # -- PrivValidator --
+
+    def get_pub_key(self):
+        if self._pub_key is None:
+            raise RemoteSignError("signer pub key not yet fetched")
+        return self._pub_key
+
+    async def sign_vote(self, chain_id: str, vote) -> None:
+        resp = await self._call({"type": "sign_vote",
+                                 "chain_id": chain_id,
+                                 "vote": vote.to_bytes().hex()})
+        signed = Vote.from_bytes(bytes.fromhex(resp["vote"]))
+        vote.signature = signed.signature
+        vote.timestamp = signed.timestamp
+
+    async def sign_proposal(self, chain_id: str, proposal) -> None:
+        resp = await self._call({"type": "sign_proposal",
+                                 "chain_id": chain_id,
+                                 "proposal": proposal.to_bytes().hex()})
+        signed = Proposal.from_bytes(bytes.fromhex(resp["proposal"]))
+        proposal.signature = signed.signature
+        proposal.timestamp = signed.timestamp

@@ -15,7 +15,14 @@ from tendermint_tpu.types.validator_set import ValidatorSet
 from tendermint_tpu.types.vote import Vote, VoteType
 
 CHAIN_ID = "test-chain"
-GENESIS_TIME = 1_700_000_000 * 1_000_000_000
+# A genesis slightly in the FUTURE makes BFT time run ahead of the
+# wall clock, so every vote timestamp hits the deterministic
+# block_time + time_iota floor (consensus voteTime) instead of the
+# wall clock — medians then agree across nodes regardless of which
+# precommit subset each assembles, which evidence timestamps rely on.
+import time as _time  # noqa: E402
+
+GENESIS_TIME = (_time.time_ns() // 1_000_000_000 + 3600) * 1_000_000_000
 
 
 def deterministic_pv(i: int) -> MockPV:

@@ -1,0 +1,170 @@
+"""Privval: FilePV double-sign protection, crash-restart signature
+re-release, and the remote signer socket pair driving real consensus
+(reference: privval/file_test.go, signer_client_test.go)."""
+
+import asyncio
+import dataclasses
+
+import pytest
+
+from tendermint_tpu.privval import (
+    FilePV, RemoteSignError, SignerClient, SignerServer, serve_signer,
+)
+from tendermint_tpu.types.block import BlockID, PartSetHeader
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote, VoteType
+
+CHAIN = "pv-chain"
+
+
+def _bid(seed: int) -> BlockID:
+    return BlockID(bytes([seed]) * 32, PartSetHeader(1, bytes([seed]) * 32))
+
+
+def _vote(height, round_, type_=VoteType.PREVOTE, bid=None, ts=1000):
+    return Vote(type=type_, height=height, round=round_,
+                block_id=bid if bid is not None else _bid(1),
+                timestamp=ts, validator_address=b"\x01" * 20,
+                validator_index=0)
+
+
+def test_sign_and_persist(tmp_path):
+    pv = FilePV.generate(str(tmp_path / "key.json"),
+                         str(tmp_path / "state.json"))
+    v = _vote(1, 0)
+    pv.sign_vote(CHAIN, v)
+    assert pv.get_pub_key().verify_signature(v.sign_bytes(CHAIN),
+                                             v.signature)
+    lss = pv.last_sign_state
+    assert (lss.height, lss.round, lss.step) == (1, 0, 2)
+
+    # identical re-sign: same signature (idempotent)
+    v2 = _vote(1, 0)
+    pv.sign_vote(CHAIN, v2)
+    assert v2.signature == v.signature
+
+    # timestamp-only change: same signature, timestamp REWOUND
+    v3 = _vote(1, 0, ts=9999)
+    pv.sign_vote(CHAIN, v3)
+    assert v3.signature == v.signature
+    assert v3.timestamp == 1000
+    assert pv.get_pub_key().verify_signature(v3.sign_bytes(CHAIN),
+                                             v3.signature)
+
+
+def test_double_sign_refused(tmp_path):
+    pv = FilePV.generate(str(tmp_path / "key.json"),
+                         str(tmp_path / "state.json"))
+    pv.sign_vote(CHAIN, _vote(2, 0))
+    # same HRS, different block → refuse
+    with pytest.raises(RemoteSignError, match="double-sign"):
+        pv.sign_vote(CHAIN, _vote(2, 0, bid=_bid(9)))
+    # regressions → refuse
+    with pytest.raises(RemoteSignError, match="height regression"):
+        pv.sign_vote(CHAIN, _vote(1, 0))
+    pv.sign_vote(CHAIN, _vote(2, 5))
+    with pytest.raises(RemoteSignError, match="round regression"):
+        pv.sign_vote(CHAIN, _vote(2, 3))
+    # prevote after precommit at same h/r → step regression
+    pv.sign_vote(CHAIN, _vote(3, 0, type_=VoteType.PRECOMMIT))
+    with pytest.raises(RemoteSignError, match="step regression"):
+        pv.sign_vote(CHAIN, _vote(3, 0, type_=VoteType.PREVOTE))
+
+
+def test_restart_resigns_identically(tmp_path):
+    key, st = str(tmp_path / "key.json"), str(tmp_path / "state.json")
+    pv = FilePV.generate(key, st)
+    v = _vote(5, 1)
+    pv.sign_vote(CHAIN, v)
+
+    pv2 = FilePV.load(key, st)  # simulated crash-restart
+    assert pv2.get_pub_key().bytes() == pv.get_pub_key().bytes()
+    # the node rebuilds the same vote with a fresh wall-clock
+    v2 = _vote(5, 1, ts=424242)
+    pv2.sign_vote(CHAIN, v2)
+    assert v2.signature == v.signature and v2.timestamp == 1000
+    # but conflicting data is still refused after restart
+    with pytest.raises(RemoteSignError):
+        pv2.sign_vote(CHAIN, _vote(5, 1, bid=_bid(8)))
+
+
+def test_proposal_signing(tmp_path):
+    pv = FilePV.generate(str(tmp_path / "k.json"), str(tmp_path / "s.json"))
+    p = Proposal(height=1, round=0, pol_round=-1, block_id=_bid(1),
+                 timestamp=777)
+    pv.sign_proposal(CHAIN, p)
+    assert pv.get_pub_key().verify_signature(p.sign_bytes(CHAIN),
+                                             p.signature)
+    # same HRS different block → refuse (propose step)
+    with pytest.raises(RemoteSignError):
+        pv.sign_proposal(CHAIN, dataclasses.replace(p, block_id=_bid(2),
+                                                    signature=b""))
+
+
+def test_remote_signer_roundtrip(tmp_path):
+    async def go():
+        pv = FilePV.generate(str(tmp_path / "k.json"),
+                             str(tmp_path / "s.json"))
+        server = await serve_signer(pv, CHAIN)
+        port = server.sockets[0].getsockname()[1]
+        client = SignerClient(CHAIN)
+        r, w = await asyncio.open_connection("127.0.0.1", port)
+        await client.connect(r, w)
+        try:
+            assert client.get_pub_key().bytes() == pv.get_pub_key().bytes()
+            await client.ping()
+            v = _vote(1, 0)
+            await client.sign_vote(CHAIN, v)
+            assert pv.get_pub_key().verify_signature(
+                v.sign_bytes(CHAIN), v.signature)
+            # double-sign attempt travels the refusal back
+            with pytest.raises(RemoteSignError, match="double-sign"):
+                await client.sign_vote(CHAIN, _vote(1, 0, bid=_bid(9)))
+            # wrong chain id refused
+            with pytest.raises(RemoteSignError, match="chain id"):
+                await client.sign_vote("other-chain", _vote(2, 0))
+            p = Proposal(height=2, round=0, pol_round=-1,
+                         block_id=_bid(3), timestamp=5)
+            await client.sign_proposal(CHAIN, p)
+            assert pv.get_pub_key().verify_signature(
+                p.sign_bytes(CHAIN), p.signature)
+        finally:
+            client.close()
+            server.close()
+
+    asyncio.run(go())
+
+
+def test_signer_dialer_mode_drives_consensus(tmp_path):
+    """The reference deployment: key process dials the node; the node's
+    consensus signs every proposal/vote through the socket."""
+    async def go():
+        from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+        from p2p_harness import P2PNode
+
+        pv = FilePV.generate(str(tmp_path / "k.json"),
+                             str(tmp_path / "s.json"))
+        gdoc = GenesisDoc(chain_id="remote-pv-chain",
+                          genesis_time=1_700_000_000 * 10**9,
+                          validators=[GenesisValidator(pv.get_pub_key(), 10)])
+        gdoc.validate_and_complete()
+
+        client = SignerClient(gdoc.chain_id)
+        port = await client.listen()
+        signer = SignerServer(pv, gdoc.chain_id)
+        signer_task = asyncio.get_running_loop().create_task(
+            signer.dial_and_serve("127.0.0.1", port))
+        await client.wait_connected()
+
+        node = P2PNode(gdoc, None, "remote-val")
+        await node.start()
+        node.cs.set_priv_validator(client)
+        try:
+            await node.cs.wait_for_height(3, timeout=60)
+            assert node.cs.rs.height >= 3
+        finally:
+            await node.stop()
+            client.close()
+            signer_task.cancel()
+
+    asyncio.run(go())
