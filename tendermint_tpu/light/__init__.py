@@ -1,0 +1,34 @@
+"""Light client: verify chain headers without executing blocks
+(reference: light/).
+
+A light client tracks a chain by verifying SignedHeaders against
+validator sets it already trusts — adjacent headers by valset-hash
+continuity, distant headers by the +1/3-trust overlap rule with
+bisection (reference light/client.go:114, verifier.go:33,102).
+All commit verification rides the batched BatchVerifier surfaces on
+ValidatorSet, so a bisection over thousands of heights is a handful
+of device batches instead of thousands of sequential CPU verifies."""
+
+from .client import Client, TrustOptions
+from .errors import (
+    DivergenceError,
+    LightClientError,
+    NewValSetCantBeTrustedError,
+    VerificationFailedError,
+)
+from .provider import BlockStoreProvider, Provider
+from .store import LightStore
+from .types import LightBlock, SignedHeader
+from .verifier import (
+    DEFAULT_TRUST_LEVEL,
+    verify_adjacent,
+    verify_non_adjacent,
+)
+
+__all__ = [
+    "Client", "TrustOptions", "LightBlock", "SignedHeader",
+    "LightStore", "Provider", "BlockStoreProvider",
+    "verify_adjacent", "verify_non_adjacent", "DEFAULT_TRUST_LEVEL",
+    "LightClientError", "VerificationFailedError",
+    "NewValSetCantBeTrustedError", "DivergenceError",
+]

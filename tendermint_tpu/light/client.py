@@ -1,0 +1,187 @@
+"""Light client core (reference: light/client.go:114).
+
+Tracks one primary provider and N witnesses. Headers from the primary
+are verified sequentially (adjacent, height by height) or by skipping
+with bisection (reference verifySkipping :683): try the target
+directly against the latest trusted block; when the trusted valset's
+overlap is below the trust level, pivot to the midpoint and recurse.
+Each verified header is cross-checked against every witness
+(reference detector.go:28); a conflicting witness raises
+DivergenceError carrying both blocks so the caller can submit
+LightClientAttackEvidence."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass
+from fractions import Fraction
+
+from .errors import (
+    DivergenceError,
+    LightClientError,
+    NewValSetCantBeTrustedError,
+)
+from .provider import Provider
+from .store import LightStore
+from .types import LightBlock
+from .verifier import DEFAULT_TRUST_LEVEL, verify, verify_adjacent
+
+logger = logging.getLogger("light")
+
+
+@dataclass
+class TrustOptions:
+    """Social-consensus root of trust (reference: light/base.go
+    TrustOptions): a height+hash the operator got out of band."""
+
+    period_ns: int
+    height: int
+    hash: bytes
+
+    def validate(self) -> None:
+        if self.period_ns <= 0:
+            raise ValueError("trusting period must be positive")
+        if self.height < 1:
+            raise ValueError("trusted height must be >= 1")
+        if len(self.hash) != 32:
+            raise ValueError("trusted hash must be 32 bytes")
+
+
+class Client:
+    def __init__(self, chain_id: str, trust_options: TrustOptions,
+                 primary: Provider, witnesses: list[Provider],
+                 store: LightStore,
+                 trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+                 now_fn=time.time_ns):
+        trust_options.validate()
+        self.chain_id = chain_id
+        self.trust_options = trust_options
+        self.primary = primary
+        self.witnesses = list(witnesses)
+        self.store = store
+        self.trust_level = trust_level
+        self.now_fn = now_fn
+        self._initialized = False
+
+    # -- bootstrap --
+
+    async def initialize(self) -> LightBlock:
+        """Fetch + pin the trusted block (reference client.go
+        initializeWithTrustOptions)."""
+        existing = self.store.get(self.trust_options.height)
+        if existing is not None:
+            self._initialized = True
+            return existing
+        lb = await self.primary.light_block(self.trust_options.height)
+        lb.validate_basic(self.chain_id)
+        if lb.hash() != self.trust_options.hash:
+            raise LightClientError(
+                f"trusted header hash mismatch at height "
+                f"{self.trust_options.height}: got {lb.hash().hex()}, "
+                f"want {self.trust_options.hash.hex()}")
+        # +2/3 of ITS OWN valset must have signed it (self-consistency)
+        lb.validator_set.verify_commit_light(
+            self.chain_id, lb.signed_header.commit.block_id,
+            lb.height(), lb.signed_header.commit)
+        self.store.save(lb)
+        self._initialized = True
+        return lb
+
+    # -- public verification API --
+
+    async def verify_light_block_at_height(self, height: int,
+                                           now_ns: int | None = None
+                                           ) -> LightBlock:
+        """reference client.go:445 VerifyLightBlockAtHeight."""
+        if not self._initialized:
+            await self.initialize()
+        now_ns = self.now_fn() if now_ns is None else now_ns
+        cached = self.store.get(height)
+        if cached is not None:
+            return cached
+        latest_trusted = self.store.latest()
+        assert latest_trusted is not None
+        if height <= latest_trusted.height():
+            raise LightClientError(
+                f"height {height} below latest trusted "
+                f"{latest_trusted.height()}; backwards verification "
+                "unsupported for now")
+        target = await self.primary.light_block(height)
+        await self._verify_skipping(latest_trusted, target, now_ns)
+        await self._detect_divergence(target, now_ns)
+        return target
+
+    async def update(self, now_ns: int | None = None) -> LightBlock | None:
+        """Verify the primary's latest header
+        (reference client.go Update)."""
+        if not self._initialized:
+            await self.initialize()
+        now_ns = self.now_fn() if now_ns is None else now_ns
+        latest = await self.primary.light_block(0)
+        trusted = self.store.latest()
+        if trusted is not None and latest.height() <= trusted.height():
+            return None
+        await self._verify_skipping(self.store.latest(), latest, now_ns)
+        await self._detect_divergence(latest, now_ns)
+        return latest
+
+    def trusted_light_block(self, height: int = 0) -> LightBlock | None:
+        return self.store.latest() if height == 0 else \
+            self.store.get(height)
+
+    # -- skipping verification with bisection --
+
+    async def _verify_skipping(self, trusted: LightBlock,
+                               target: LightBlock, now_ns: int) -> None:
+        """reference client.go:683 verifySkipping. Iterative pivoting:
+        keep a stack of unverified blocks; verify what we can against
+        the current trusted head, bisect when trust is insufficient."""
+        pending: list[LightBlock] = [target]
+        cache: dict[int, LightBlock] = {target.height(): target}
+        steps = 0
+        while pending:
+            steps += 1
+            if steps > 200:  # 2^200 heights — unreachable honestly
+                raise LightClientError("bisection did not converge")
+            block = pending[-1]
+            try:
+                verify(self.chain_id, trusted, block,
+                       self.trust_options.period_ns, now_ns,
+                       self.trust_level)
+            except NewValSetCantBeTrustedError:
+                pivot_h = (trusted.height() + block.height()) // 2
+                if pivot_h in (trusted.height(), block.height()) or \
+                        pivot_h in cache:
+                    raise  # can't split further: genuine failure
+                pivot = await self.primary.light_block(pivot_h)
+                cache[pivot_h] = pivot
+                pending.append(pivot)
+                continue
+            self.store.save(block)
+            trusted = block
+            pending.pop()
+
+    # -- witness cross-checking --
+
+    async def _detect_divergence(self, verified: LightBlock,
+                                 now_ns: int) -> None:
+        """reference light/detector.go:28 detectDivergence."""
+        if not self.witnesses:
+            return
+        results = await asyncio.gather(
+            *(self._compare_with_witness(i, w, verified)
+              for i, w in enumerate(self.witnesses)),
+            return_exceptions=True)
+        for i, res in enumerate(results):
+            if isinstance(res, DivergenceError):
+                raise res
+            if isinstance(res, BaseException):
+                logger.warning("witness %d unreachable: %r", i, res)
+
+    async def _compare_with_witness(self, idx: int, witness: Provider,
+                                    verified: LightBlock) -> None:
+        wb = await witness.light_block(verified.height())
+        if wb.hash() != verified.hash():
+            raise DivergenceError(idx, wb, verified)

@@ -1,0 +1,67 @@
+"""Trusted light-block store (reference: light/store/db/db.go).
+
+Persists verified LightBlocks keyed by height; the client resumes from
+the highest trusted block after restart."""
+
+from __future__ import annotations
+
+import json
+
+from ..state.store import _valset_from_json, _valset_to_json
+from ..types.block import Commit, Header
+from .types import LightBlock, SignedHeader
+
+_PREFIX = b"lb/"
+
+
+def _key(height: int) -> bytes:
+    return _PREFIX + height.to_bytes(8, "big")
+
+
+class LightStore:
+    def __init__(self, db):
+        self.db = db
+
+    def save(self, lb: LightBlock) -> None:
+        payload = json.dumps({
+            "header": lb.signed_header.header.to_proto().finish().hex(),
+            "commit": lb.signed_header.commit.to_bytes().hex(),
+            "validators": _valset_to_json(lb.validator_set),
+        }).encode()
+        self.db.set(_key(lb.height()), payload)
+
+    def get(self, height: int) -> LightBlock | None:
+        raw = self.db.get(_key(height))
+        if raw is None:
+            return None
+        d = json.loads(raw)
+        return LightBlock(
+            SignedHeader(Header.from_bytes(bytes.fromhex(d["header"])),
+                         Commit.from_bytes(bytes.fromhex(d["commit"]))),
+            _valset_from_json(d["validators"]),
+        )
+
+    def latest(self) -> LightBlock | None:
+        latest_h = self.latest_height()
+        return self.get(latest_h) if latest_h else None
+
+    def latest_height(self) -> int:
+        best = 0
+        for k, _ in self.db.iterate_prefix(_PREFIX):
+            h = int.from_bytes(k[len(_PREFIX):], "big")
+            best = max(best, h)
+        return best
+
+    def lowest_height(self) -> int:
+        for k, _ in self.db.iterate_prefix(_PREFIX):
+            return int.from_bytes(k[len(_PREFIX):], "big")
+        return 0
+
+    def heights(self) -> list[int]:
+        return [int.from_bytes(k[len(_PREFIX):], "big")
+                for k, _ in self.db.iterate_prefix(_PREFIX)]
+
+    def prune(self, keep: int) -> None:
+        hs = self.heights()
+        for h in hs[:-keep] if keep else hs:
+            self.db.delete(_key(h))

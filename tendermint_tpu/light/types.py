@@ -1,0 +1,53 @@
+"""Light-client data types (reference: types/light.go).
+
+A LightBlock is the minimum a light client needs per height: the
+signed header (header + commit) and the validator set that signed it."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..types.block import Commit, Header
+from ..types.validator_set import ValidatorSet
+
+
+@dataclass
+class SignedHeader:
+    header: Header
+    commit: Commit
+
+    def validate_basic(self, chain_id: str) -> None:
+        if self.header is None or self.commit is None:
+            raise ValueError("signed header missing header or commit")
+        self.header.validate_basic()
+        self.commit.validate_basic()
+        if self.header.chain_id != chain_id:
+            raise ValueError(
+                f"header chain id {self.header.chain_id!r} != {chain_id!r}")
+        if self.commit.height != self.header.height:
+            raise ValueError("commit height != header height")
+        if self.commit.block_id.hash != self.header.hash():
+            raise ValueError("commit is for a different block")
+
+
+@dataclass
+class LightBlock:
+    signed_header: SignedHeader
+    validator_set: ValidatorSet
+
+    def height(self) -> int:
+        return self.signed_header.header.height
+
+    def time(self) -> int:
+        return self.signed_header.header.time
+
+    def hash(self) -> bytes:
+        return self.signed_header.header.hash()
+
+    def validate_basic(self, chain_id: str) -> None:
+        self.signed_header.validate_basic(chain_id)
+        self.validator_set.validate_basic()
+        if self.signed_header.header.validators_hash != \
+                self.validator_set.hash():
+            raise ValueError(
+                "validator set does not match header validators_hash")

@@ -1,0 +1,103 @@
+"""Header verification rules (reference: light/verifier.go).
+
+verify_adjacent  (:102): heights differ by 1 — the trusted header's
+next_validators_hash must equal the new header's validators_hash, then
+the new valset's commit is checked (+2/3, batched).
+
+verify_non_adjacent (:33): any height gap — the TRUSTED valset must
+have signed the new commit with ≥ trust-level (default 1/3) of its
+power (batched, address-matched), then the new valset's own commit is
+checked (+2/3, batched). Raises NewValSetCantBeTrustedError when the
+overlap is insufficient, which drives the client's bisection."""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+from ..types.validator_set import VerificationError
+from .errors import (
+    NewValSetCantBeTrustedError,
+    OutsideTrustingPeriodError,
+    VerificationFailedError,
+)
+from .types import LightBlock
+
+DEFAULT_TRUST_LEVEL = Fraction(1, 3)
+MAX_CLOCK_DRIFT_NS = 10 * 1_000_000_000  # reference defaultMaxClockDrift
+
+
+def _common_checks(chain_id: str, trusted: LightBlock,
+                   untrusted: LightBlock, trusting_period_ns: int,
+                   now_ns: int) -> None:
+    untrusted.validate_basic(chain_id)
+    if untrusted.height() <= trusted.height():
+        raise VerificationFailedError(
+            f"target height {untrusted.height()} not above trusted "
+            f"{trusted.height()}")
+    # the trusted header must still be inside its trusting period,
+    # else its valset may have long unbonded (reference HeaderExpired)
+    if trusted.time() + trusting_period_ns <= now_ns:
+        raise OutsideTrustingPeriodError(
+            f"trusted header from {trusted.time()} expired")
+    if untrusted.time() <= trusted.time():
+        raise VerificationFailedError(
+            "untrusted header time not after trusted header time")
+    if untrusted.time() >= now_ns + MAX_CLOCK_DRIFT_NS:
+        raise VerificationFailedError(
+            "untrusted header is from the future (clock drift exceeded)")
+
+
+def verify_adjacent(chain_id: str, trusted: LightBlock,
+                    untrusted: LightBlock, trusting_period_ns: int,
+                    now_ns: int) -> None:
+    if untrusted.height() != trusted.height() + 1:
+        raise VerificationFailedError("headers must be adjacent")
+    _common_checks(chain_id, trusted, untrusted, trusting_period_ns,
+                   now_ns)
+    if untrusted.signed_header.header.validators_hash != \
+            trusted.signed_header.header.next_validators_hash:
+        raise VerificationFailedError(
+            "new validators_hash != trusted next_validators_hash")
+    sh = untrusted.signed_header
+    try:
+        untrusted.validator_set.verify_commit_light(
+            chain_id, sh.commit.block_id, sh.header.height, sh.commit)
+    except VerificationError as e:
+        raise VerificationFailedError(f"invalid commit: {e}") from e
+
+
+def verify_non_adjacent(chain_id: str, trusted: LightBlock,
+                        untrusted: LightBlock, trusting_period_ns: int,
+                        now_ns: int,
+                        trust_level: Fraction = DEFAULT_TRUST_LEVEL) -> None:
+    if untrusted.height() == trusted.height() + 1:
+        return verify_adjacent(chain_id, trusted, untrusted,
+                               trusting_period_ns, now_ns)
+    _common_checks(chain_id, trusted, untrusted, trusting_period_ns,
+                   now_ns)
+    sh = untrusted.signed_header
+    # ≥ trust-level of the TRUSTED valset must have signed the new block
+    try:
+        trusted.validator_set.verify_commit_light_trusting(
+            chain_id, sh.commit,
+            trust_level.numerator, trust_level.denominator)
+    except VerificationError as e:
+        raise NewValSetCantBeTrustedError(str(e)) from e
+    # and the new valset itself must have +2/3 committed it
+    try:
+        untrusted.validator_set.verify_commit_light(
+            chain_id, sh.commit.block_id, sh.header.height, sh.commit)
+    except VerificationError as e:
+        raise VerificationFailedError(f"invalid commit: {e}") from e
+
+
+def verify(chain_id: str, trusted: LightBlock, untrusted: LightBlock,
+           trusting_period_ns: int, now_ns: int,
+           trust_level: Fraction = DEFAULT_TRUST_LEVEL) -> None:
+    """reference: light/verifier.go:150 Verify — dispatch on adjacency."""
+    if untrusted.height() == trusted.height() + 1:
+        verify_adjacent(chain_id, trusted, untrusted, trusting_period_ns,
+                        now_ns)
+    else:
+        verify_non_adjacent(chain_id, trusted, untrusted,
+                            trusting_period_ns, now_ns, trust_level)

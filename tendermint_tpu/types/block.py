@@ -210,7 +210,7 @@ class Commit:
     round: int
     block_id: BlockID
     signatures: list[CommitSig]
-    _hash: bytes | None = field(default=None, repr=False, compare=False)
+    _hash: bytes | None = field(default=None, repr=False, compare=False, init=False)
 
     def validate_basic(self) -> None:
         if self.height < 0:
@@ -306,7 +306,7 @@ class Header:
     last_results_hash: bytes
     evidence_hash: bytes
     proposer_address: bytes
-    _hash: bytes | None = field(default=None, repr=False, compare=False)
+    _hash: bytes | None = field(default=None, repr=False, compare=False, init=False)
 
     def hash(self) -> bytes:
         """Merkle root of the deterministically-encoded fields
@@ -347,7 +347,8 @@ class Header:
             raise ValueError("bad chain id")
         if self.height < 0:
             raise ValueError("negative height")
-        self.last_block_id.validate_basic()
+        if self.last_block_id is not None:  # None = genesis (Go zero value)
+            self.last_block_id.validate_basic()
         for name in (
             "last_commit_hash", "data_hash", "validators_hash",
             "next_validators_hash", "consensus_hash", "last_results_hash",

@@ -1,0 +1,215 @@
+"""Light client: verifier rules, bisection, witness divergence, and
+verification against a live node (reference: light/verifier_test.go,
+client_test.go, detector_test.go)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.light import (
+    BlockStoreProvider, Client, DivergenceError, LightBlock, LightStore,
+    SignedHeader, TrustOptions, verify_adjacent, verify_non_adjacent,
+)
+from tendermint_tpu.light.errors import (
+    NewValSetCantBeTrustedError, OutsideTrustingPeriodError,
+    VerificationFailedError,
+)
+from tendermint_tpu.light.provider import BlockNotFoundError, Provider
+from tendermint_tpu.types.block import BlockID, Header, PartSetHeader
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.validator import Validator
+
+from helpers import CHAIN_ID, deterministic_pv, sign_commit
+
+HOUR = 3600 * 1_000_000_000
+T0 = 1_700_000_000 * 1_000_000_000
+
+
+def _valset(indices):
+    vals = [Validator.new(deterministic_pv(i).get_pub_key(), 10)
+            for i in indices]
+    return ValidatorSet(vals), [deterministic_pv(i) for i in indices]
+
+
+class LightChain:
+    """Deterministic header chain with per-height validator sets."""
+
+    def __init__(self, n_heights, valset_for=lambda h: tuple(range(4))):
+        self.blocks: dict[int, LightBlock] = {}
+        sets = {h: _valset(valset_for(h))
+                for h in range(1, n_heights + 2)}
+        for h in range(1, n_heights + 1):
+            vals, pvs = sets[h]
+            nvals, _ = sets[h + 1]
+            header = Header(
+                version_block=11, version_app=0, chain_id=CHAIN_ID,
+                height=h, time=T0 + h * 1_000_000_000,
+                last_block_id=None,
+                last_commit_hash=b"\x01" * 32, data_hash=b"\x02" * 32,
+                validators_hash=vals.hash(),
+                next_validators_hash=nvals.hash(),
+                consensus_hash=b"\x03" * 32, app_hash=b"\x04" * 32,
+                last_results_hash=b"\x05" * 32,
+                evidence_hash=b"\x06" * 32,
+                proposer_address=vals.get_proposer().address,
+            )
+            bid = BlockID(header.hash(), PartSetHeader(1, b"\x07" * 32))
+            commit = sign_commit(vals, pvs, CHAIN_ID, h, 0, bid,
+                                 header.time + 1)
+            self.blocks[h] = LightBlock(SignedHeader(header, commit), vals)
+
+    def provider(self, tamper_height=None):
+        chain = self
+
+        class P(Provider):
+            async def light_block(self, height):
+                if height == 0:
+                    height = max(chain.blocks)
+                lb = chain.blocks.get(height)
+                if lb is None:
+                    raise BlockNotFoundError(str(height))
+                if height == tamper_height:
+                    h2 = lb.signed_header.header
+                    import dataclasses
+                    forged = dataclasses.replace(h2, app_hash=b"\xee" * 32)
+                    return LightBlock(
+                        SignedHeader(forged, lb.signed_header.commit),
+                        lb.validator_set)
+                return lb
+
+        return P()
+
+
+NOW = T0 + 100 * 1_000_000_000
+
+
+def test_verify_adjacent_ok_and_failures():
+    c = LightChain(3)
+    b1, b2 = c.blocks[1], c.blocks[2]
+    verify_adjacent(CHAIN_ID, b1, b2, HOUR, NOW)
+    # expired trusting period
+    with pytest.raises(OutsideTrustingPeriodError):
+        verify_adjacent(CHAIN_ID, b1, b2, 1, NOW)
+    # non-adjacent heights refused by the adjacent path
+    with pytest.raises(VerificationFailedError, match="adjacent"):
+        verify_adjacent(CHAIN_ID, b1, c.blocks[3], HOUR, NOW)
+    # tampered header: commit no longer matches
+    import dataclasses
+    forged_header = dataclasses.replace(b2.signed_header.header,
+                                        app_hash=b"\xee" * 32)
+    forged = LightBlock(SignedHeader(forged_header,
+                                     b2.signed_header.commit),
+                        b2.validator_set)
+    with pytest.raises(Exception):
+        verify_adjacent(CHAIN_ID, b1, forged, HOUR, NOW)
+
+
+def test_verify_non_adjacent_trust_overlap():
+    # constant valset: full overlap, skipping succeeds across the gap
+    c = LightChain(10)
+    verify_non_adjacent(CHAIN_ID, c.blocks[1], c.blocks[10], HOUR, NOW)
+    # complete valset replacement mid-chain: no overlap → can't trust
+    c2 = LightChain(10, valset_for=lambda h: tuple(range(4)) if h <= 5
+                    else tuple(range(10, 14)))
+    with pytest.raises(NewValSetCantBeTrustedError):
+        verify_non_adjacent(CHAIN_ID, c2.blocks[1], c2.blocks[10],
+                            HOUR, NOW)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _client(chain, trust_height=1, witnesses=(), primary=None):
+    return Client(
+        CHAIN_ID,
+        TrustOptions(period_ns=HOUR, height=trust_height,
+                     hash=chain.blocks[trust_height].hash()),
+        primary or chain.provider(),
+        list(witnesses),
+        LightStore(MemDB()),
+        now_fn=lambda: NOW,
+    )
+
+
+def test_client_sequential_and_skipping():
+    chain = LightChain(20)
+    cl = _client(chain)
+    lb = run(cl.verify_light_block_at_height(20))
+    assert lb.height() == 20
+    # everything verified landed in the trusted store
+    assert cl.store.latest_height() == 20
+
+
+def test_client_bisection_through_valset_rotation():
+    # valset rotates one member every height: adjacent fully verifiable,
+    # distant jumps lose 1/3 overlap and force bisection
+    chain = LightChain(
+        16, valset_for=lambda h: tuple(range(h, h + 4)))
+    cl = _client(chain)
+    lb = run(cl.verify_light_block_at_height(16))
+    assert lb.height() == 16
+    heights = cl.store.heights()
+    assert 16 in heights and len(heights) > 2  # pivots were stored
+
+
+def test_client_rejects_wrong_trust_hash():
+    chain = LightChain(5)
+    cl = Client(CHAIN_ID,
+                TrustOptions(period_ns=HOUR, height=1, hash=b"\xab" * 32),
+                chain.provider(), [], LightStore(MemDB()),
+                now_fn=lambda: NOW)
+    with pytest.raises(Exception, match="hash mismatch"):
+        run(cl.initialize())
+
+
+def test_client_detects_witness_divergence():
+    chain = LightChain(8)
+    honest = chain.provider()
+    lying = chain.provider(tamper_height=8)
+    cl = _client(chain, witnesses=[honest, lying])
+    with pytest.raises(DivergenceError) as ei:
+        run(cl.verify_light_block_at_height(8))
+    assert ei.value.witness_index == 1
+
+
+def test_client_update_to_latest():
+    chain = LightChain(12)
+    cl = _client(chain)
+    lb = run(cl.update())
+    assert lb is not None and lb.height() == 12
+    assert run(cl.update()) is None  # already at head
+
+
+def test_light_client_against_live_node():
+    async def go():
+        from helpers import make_genesis
+        from p2p_harness import P2PNode
+
+        gdoc, pvs = make_genesis(1)
+        node = P2PNode(gdoc, pvs[0], "full")
+        await node.start()
+        try:
+            await node.cs.wait_for_height(5, timeout=60)
+            prov = BlockStoreProvider(node.block_store,
+                                      node.cs.block_exec.store)
+            trusted = await prov.light_block(1)
+            cl = Client(
+                gdoc.chain_id,
+                TrustOptions(period_ns=HOUR, height=1,
+                             hash=trusted.hash()),
+                prov, [prov], LightStore(MemDB()),
+                # the test harness runs its chain clock ahead of the
+                # wall clock (future genesis, see helpers.GENESIS_TIME)
+                now_fn=lambda: gdoc.genesis_time + HOUR // 2,
+            )
+            lb = await cl.verify_light_block_at_height(4)
+            assert lb.height() == 4
+            assert lb.hash() == \
+                node.block_store.load_block_meta(4).block_id.hash
+        finally:
+            await node.stop()
+
+    run(go())
