@@ -80,12 +80,18 @@ class PersistentKVStoreApp(KVStoreApp):
 
     SNAPSHOT_CHUNK_SIZE = 1 << 16
 
-    def __init__(self, db: DB | None = None):
+    def __init__(self, db: DB | None = None, snapshot_interval: int = 0,
+                 keep_snapshots: int = 4):
         super().__init__()
         self.db = db or MemDB()
         self.val_updates: list[t.ValidatorUpdate] = []
         self.validators: dict[str, int] = {}  # pubkey hex -> power
         self.retain_blocks = 0
+        # taken every snapshot_interval heights, last keep_snapshots
+        # retained (reference: test/e2e/app snapshot_interval); 0 =
+        # advertise only the live head state
+        self.snapshot_interval = snapshot_interval
+        self.keep_snapshots = keep_snapshots
         st = self.db.get(_STATE_KEY)
         if st is not None:
             d = json.loads(st)
@@ -144,6 +150,13 @@ class PersistentKVStoreApp(KVStoreApp):
             "app_hash": self.app_hash.hex(),
             "validators": self.validators,
         }).encode())
+        if self.snapshot_interval and \
+                self.height % self.snapshot_interval == 0:
+            self.db.set(b"snap:%016x" % self.height,
+                        self._snapshot_payload())
+            snaps = [k for k, _ in self.db.iterate_prefix(b"snap:")]
+            for k in snaps[:-self.keep_snapshots]:
+                self.db.delete(k)
         resp = t.ResponseCommit(data=self.app_hash)
         if self.retain_blocks > 0 and self.height > self.retain_blocks:
             resp.retain_height = self.height - self.retain_blocks
@@ -168,21 +181,32 @@ class PersistentKVStoreApp(KVStoreApp):
             "app_hash": self.app_hash.hex(), "validators": self.validators,
         }, sort_keys=True).encode()
 
+    def _stored_snapshots(self) -> list[tuple[int, bytes]]:
+        out = [(int(k[len(b"snap:"):], 16), v)
+               for k, v in self.db.iterate_prefix(b"snap:")]
+        if not out and self.height > 0:
+            out = [(self.height, self._snapshot_payload())]
+        return out
+
     def list_snapshots(self, req: t.RequestListSnapshots) -> t.ResponseListSnapshots:
-        if self.height == 0:
-            return t.ResponseListSnapshots()
         from ..crypto import tmhash
 
-        payload = self._snapshot_payload()
-        n = max(1, -(-len(payload) // self.SNAPSHOT_CHUNK_SIZE))
-        return t.ResponseListSnapshots([
-            t.Snapshot(self.height, 1, n, tmhash.sum256(payload))
-        ])
+        snaps = []
+        for height, payload in self._stored_snapshots():
+            n = max(1, -(-len(payload) // self.SNAPSHOT_CHUNK_SIZE))
+            snaps.append(t.Snapshot(height, 1, n, tmhash.sum256(payload)))
+        return t.ResponseListSnapshots(snaps)
 
     def load_snapshot_chunk(
         self, req: t.RequestLoadSnapshotChunk
     ) -> t.ResponseLoadSnapshotChunk:
-        payload = self._snapshot_payload()
+        payload = None
+        for height, p in self._stored_snapshots():
+            if height == req.height:
+                payload = p
+                break
+        if payload is None:
+            return t.ResponseLoadSnapshotChunk(b"")
         start = req.chunk * self.SNAPSHOT_CHUNK_SIZE
         return t.ResponseLoadSnapshotChunk(
             payload[start : start + self.SNAPSHOT_CHUNK_SIZE]

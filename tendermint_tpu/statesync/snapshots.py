@@ -1,0 +1,77 @@
+"""Snapshot pool: collect snapshot advertisements from peers and rank
+them (reference: statesync/snapshots.go:45 snapshotPool).
+
+Ranking (reference :176 Best): higher height first, then lower format
+... then most peers. Rejected snapshots/formats/peers are remembered
+so SyncAny never retries them."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Snapshot:
+    height: int
+    format: int
+    chunks: int
+    hash: bytes
+    metadata: bytes = b""
+
+    def key(self) -> tuple:
+        return (self.height, self.format, self.chunks, self.hash)
+
+
+class SnapshotPool:
+    def __init__(self):
+        self._snapshots: dict[tuple, Snapshot] = {}
+        self._peers: dict[tuple, set[str]] = {}
+        self._rejected_snapshots: set[tuple] = set()
+        self._rejected_formats: set[int] = set()
+        self._rejected_peers: set[str] = set()
+
+    def add(self, peer_id: str, snapshot: Snapshot) -> bool:
+        """Returns True if this snapshot is new to the pool."""
+        k = snapshot.key()
+        if k in self._rejected_snapshots or \
+                snapshot.format in self._rejected_formats or \
+                peer_id in self._rejected_peers:
+            return False
+        new = k not in self._snapshots
+        self._snapshots[k] = snapshot
+        self._peers.setdefault(k, set()).add(peer_id)
+        return new
+
+    def best(self) -> Snapshot | None:
+        ranked = sorted(
+            self._snapshots.values(),
+            key=lambda s: (-s.height, s.format,
+                           -len(self._peers.get(s.key(), ()))))
+        return ranked[0] if ranked else None
+
+    def peers_of(self, snapshot: Snapshot) -> list[str]:
+        return sorted(self._peers.get(snapshot.key(), set()))
+
+    def reject(self, snapshot: Snapshot) -> None:
+        self._rejected_snapshots.add(snapshot.key())
+        self._snapshots.pop(snapshot.key(), None)
+
+    def reject_format(self, format_: int) -> None:
+        self._rejected_formats.add(format_)
+        for k in [k for k, s in self._snapshots.items()
+                  if s.format == format_]:
+            del self._snapshots[k]
+
+    def reject_peer(self, peer_id: str) -> None:
+        self._rejected_peers.add(peer_id)
+        self.remove_peer(peer_id)
+
+    def remove_peer(self, peer_id: str) -> None:
+        for k, peers in list(self._peers.items()):
+            peers.discard(peer_id)
+            if not peers:
+                del self._peers[k]
+                self._snapshots.pop(k, None)
+
+    def __len__(self) -> int:
+        return len(self._snapshots)

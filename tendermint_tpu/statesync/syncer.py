@@ -1,0 +1,220 @@
+"""The state-sync driver (reference: statesync/syncer.go).
+
+Pure-ish core: peer IO goes through two callables the reactor wires in
+(`request_snapshots(peer)` and `request_chunk(peer_id, snapshot, idx)`)
+so the whole flow is unit-testable without sockets. Chunks are held in
+memory (a redesign of the reference's temp-file chunkQueue — snapshot
+chunks are bounded at 16MB and restore is transient)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..abci import types as abci
+from .snapshots import Snapshot, SnapshotPool
+
+logger = logging.getLogger("statesync")
+
+CHUNK_TIMEOUT = 10.0       # reference chunkTimeout (10s)
+DISCOVERY_TIME = 2.0       # reference defaultDiscoveryTime scaled for tests
+CHUNK_FETCHERS = 4         # reference cfg.ChunkFetchers
+
+
+class StateSyncError(Exception):
+    pass
+
+
+class _AbortSync(StateSyncError):
+    pass
+
+
+class _RejectSnapshot(StateSyncError):
+    pass
+
+
+class _RejectFormat(StateSyncError):
+    pass
+
+
+class Syncer:
+    def __init__(self, app_snapshot_conn, state_provider,
+                 request_chunk, discovery_time: float = DISCOVERY_TIME):
+        self.app = app_snapshot_conn
+        self.state_provider = state_provider
+        self.request_chunk = request_chunk  # async (peer_id, snapshot, idx)
+        self.discovery_time = discovery_time
+        self.pool = SnapshotPool()
+        self._chunks: dict[int, bytes] = {}
+        self._chunk_event = asyncio.Event()
+        self._active: Snapshot | None = None
+
+    # -- inbound from reactor --
+
+    def add_snapshot(self, peer_id: str, snapshot: Snapshot) -> bool:
+        new = self.pool.add(peer_id, snapshot)
+        if new:
+            logger.info("discovered snapshot h=%d format=%d from %s",
+                        snapshot.height, snapshot.format, peer_id[:8])
+        return new
+
+    def add_chunk(self, msg) -> None:
+        if self._active is None or msg.height != self._active.height or \
+                msg.format != self._active.format:
+            return
+        if msg.missing or msg.index in self._chunks:
+            return
+        if not 0 <= msg.index < self._active.chunks:
+            return
+        self._chunks[msg.index] = msg.chunk
+        self._chunk_event.set()
+
+    def remove_peer(self, peer_id: str) -> None:
+        self.pool.remove_peer(peer_id)
+
+    # -- main flow --
+
+    async def sync_any(self):
+        """Try snapshots best-first until one restores and verifies.
+        Returns (state, commit) for node bootstrap
+        (reference: syncer.go:141 SyncAny)."""
+        deadline = asyncio.get_running_loop().time() + self.discovery_time
+        while True:
+            snapshot = self.pool.best()
+            if snapshot is None:
+                if asyncio.get_running_loop().time() > deadline:
+                    raise StateSyncError("no viable snapshots discovered")
+                await asyncio.sleep(0.1)
+                continue
+            try:
+                return await self._sync(snapshot)
+            except _AbortSync:
+                raise StateSyncError("app aborted state sync")
+            except _RejectFormat:
+                logger.info("app rejected snapshot format %d",
+                            snapshot.format)
+                self.pool.reject_format(snapshot.format)
+            except _RejectSnapshot:
+                logger.info("snapshot h=%d rejected", snapshot.height)
+                self.pool.reject(snapshot)
+            except StateSyncError as e:
+                logger.warning("snapshot h=%d failed: %s; trying next",
+                               snapshot.height, e)
+                self.pool.reject(snapshot)
+
+    async def _sync(self, snapshot: Snapshot):
+        # 1) the app hash we must end up with — light-verified FIRST so
+        # an unverifiable height fails before any restore work
+        app_hash = await self.state_provider.app_hash(snapshot.height)
+
+        # 2) offer to the app
+        res = await self.app.offer_snapshot(abci.RequestOfferSnapshot(
+            snapshot=abci.Snapshot(
+                height=snapshot.height, format=snapshot.format,
+                chunks=snapshot.chunks, hash=snapshot.hash,
+                metadata=snapshot.metadata),
+            app_hash=app_hash))
+        self._dispatch_offer_result(res.result)
+
+        # 3) fetch + apply chunks
+        self._active = snapshot
+        self._chunks = {}
+        try:
+            await self._fetch_and_apply(snapshot)
+        finally:
+            self._active = None
+
+        # 4) confirm the restored app
+        info = await self.app.info(abci.RequestInfo())
+        if info.last_block_app_hash != app_hash:
+            raise StateSyncError(
+                f"restored app hash {info.last_block_app_hash.hex()} != "
+                f"trusted {app_hash.hex()}")
+        if info.last_block_height != snapshot.height:
+            raise StateSyncError(
+                f"restored app height {info.last_block_height} != "
+                f"snapshot height {snapshot.height}")
+
+        state = await self.state_provider.state(snapshot.height)
+        commit = await self.state_provider.commit(snapshot.height)
+        logger.info("snapshot restored and verified at height %d",
+                    snapshot.height)
+        return state, commit
+
+    def _dispatch_offer_result(self, result: int) -> None:
+        R = abci.OfferSnapshotResult
+        if result == R.ACCEPT:
+            return
+        if result == R.ABORT:
+            raise _AbortSync()
+        if result == R.REJECT_FORMAT:
+            raise _RejectFormat()
+        if result in (R.REJECT, R.REJECT_SENDER, R.UNKNOWN):
+            raise _RejectSnapshot()
+        raise StateSyncError(f"unknown offer result {result}")
+
+    async def _fetch_and_apply(self, snapshot: Snapshot) -> None:
+        applied = 0
+        requested: dict[int, float] = {}
+        loop = asyncio.get_running_loop()
+        while applied < snapshot.chunks:
+            peers = self.pool.peers_of(snapshot)
+            if not peers:
+                raise StateSyncError("no peers hold the snapshot")
+            # (re-)request missing chunks, round-robin over peers
+            now = loop.time()
+            outstanding = 0
+            for idx in range(applied, snapshot.chunks):
+                if idx in self._chunks:
+                    continue
+                if outstanding >= CHUNK_FETCHERS:
+                    break
+                last = requested.get(idx, 0.0)
+                if now - last > CHUNK_TIMEOUT or last == 0.0:
+                    peer = peers[idx % len(peers)] if last == 0.0 else \
+                        peers[(idx + 1) % len(peers)]
+                    await self.request_chunk(peer, snapshot, idx)
+                    requested[idx] = now
+                outstanding += 1
+            # apply whatever is ready, in order
+            progressed = False
+            while applied in self._chunks:
+                chunk = self._chunks[applied]
+                res = await self.app.apply_snapshot_chunk(
+                    abci.RequestApplySnapshotChunk(
+                        index=applied, chunk=chunk, sender=""))
+                applied = self._dispatch_apply_result(res, applied,
+                                                      requested)
+                progressed = True
+            if applied >= snapshot.chunks:
+                return
+            if not progressed:
+                self._chunk_event.clear()
+                try:
+                    await asyncio.wait_for(self._chunk_event.wait(),
+                                           CHUNK_TIMEOUT)
+                except asyncio.TimeoutError:
+                    # force re-requests next loop
+                    for idx in list(requested):
+                        if idx not in self._chunks:
+                            requested[idx] = 0.0
+
+    def _dispatch_apply_result(self, res, applied: int,
+                               requested: dict) -> int:
+        R = abci.ApplySnapshotChunkResult
+        if res.result == R.ACCEPT:
+            for idx in res.refetch_chunks:
+                self._chunks.pop(idx, None)
+                requested[idx] = 0.0
+            return applied + 1
+        if res.result == R.RETRY:
+            self._chunks.pop(applied, None)
+            requested[applied] = 0.0
+            return applied
+        if res.result == R.ABORT:
+            raise _AbortSync()
+        if res.result == R.RETRY_SNAPSHOT:
+            raise StateSyncError("app requested snapshot retry")
+        if res.result == R.REJECT_SNAPSHOT:
+            raise _RejectSnapshot()
+        raise StateSyncError(f"unknown apply result {res.result}")

@@ -21,6 +21,7 @@ from tendermint_tpu.p2p.transport import Transport
 from tendermint_tpu.proxy import AppConns
 from tendermint_tpu.state.execution import BlockExecutor
 from tendermint_tpu.state.store import Store
+from tendermint_tpu.statesync.reactor import StateSyncReactor
 from tendermint_tpu.store import BlockStore
 from tendermint_tpu.types.events import EventBus
 
@@ -29,11 +30,14 @@ class P2PNode:
     """A node wired through a real Switch; consensus reactor always,
     blockchain reactor optional (fast_sync)."""
 
-    def __init__(self, gdoc, pv, moniker, fast_sync=False):
+    def __init__(self, gdoc, pv, moniker, fast_sync=False,
+                 snapshot_interval=0, state_provider_factory=None):
         self.gdoc = gdoc
         self.pv = pv
         self.moniker = moniker
         self.fast_sync = fast_sync
+        self.snapshot_interval = snapshot_interval
+        self.state_provider_factory = state_provider_factory
         self.node_key = NodeKey.generate()
         self.switch = None
         self.cs = None
@@ -42,7 +46,8 @@ class P2PNode:
     async def start(self, wait_sync=None):
         if wait_sync is None:
             wait_sync = self.fast_sync
-        self.app = PersistentKVStoreApp(MemDB())
+        self.app = PersistentKVStoreApp(
+            MemDB(), snapshot_interval=self.snapshot_interval)
         self.conns = AppConns(ClientCreator(app=self.app))
         await self.conns.start()
         state_store = Store(MemDB())
@@ -63,6 +68,10 @@ class P2PNode:
             state, executor, self.block_store, fast_sync=self.fast_sync,
             consensus_reactor=self.reactor)
         self.ev_reactor = EvidenceReactor(self.evpool)
+        provider = (self.state_provider_factory(self)
+                    if self.state_provider_factory else None)
+        self.ss_reactor = StateSyncReactor(self.conns.snapshot, provider)
+        self.state_store = state_store
 
         holder = {}
 
@@ -73,7 +82,7 @@ class P2PNode:
                             network=self.gdoc.chain_id,
                             moniker=self.moniker,
                             channels=bytes([0x20, 0x21, 0x22, 0x23,
-                                            0x38, 0x40]))
+                                            0x38, 0x40, 0x60, 0x61]))
 
         transport = Transport(self.node_key, ni)
         holder["transport"] = transport
@@ -81,6 +90,7 @@ class P2PNode:
         self.switch.add_reactor("consensus", self.reactor)
         self.switch.add_reactor("blockchain", self.bc_reactor)
         self.switch.add_reactor("evidence", self.ev_reactor)
+        self.switch.add_reactor("statesync", self.ss_reactor)
         await transport.listen("127.0.0.1", 0)
         await self.switch.start()
         await self.bc_reactor.start()
