@@ -49,6 +49,10 @@ class BlockPool:
         self.peers: dict[str, _Peer] = {}
         self.requests: dict[int, _Request] = {}
         self._banned: set[str] = set()
+        # monotonic timestamp of the last height advance; fed by the
+        # reactor's clock (None until the first tick) so the pool stays
+        # clock-free and deterministic in tests
+        self.last_advance: float | None = None
 
     # -- peers --
 
@@ -118,6 +122,8 @@ class BlockPool:
     def tick(self, now: float) -> list[str]:
         """Expire timed-out requests; returns peer ids to drop
         (reference: requestRoutine timeout → RemovePeer)."""
+        if self.last_advance is None:
+            self.last_advance = now
         bad: set[str] = set()
         for req in list(self.requests.values()):
             if req.block is None and now - req.sent_at > REQUEST_TIMEOUT:
@@ -173,10 +179,12 @@ class BlockPool:
             out.append(req.block)
         return out
 
-    def pop_request(self) -> None:
+    def pop_request(self, now: float | None = None) -> None:
         req = self.requests.pop(self.height, None)
         assert req is not None and req.block is not None
         self.height += 1
+        if now is not None:
+            self.last_advance = now
 
     def redo_request(self, height: int) -> str:
         """Block at `height` failed verification: ban the peer that sent
@@ -193,7 +201,8 @@ class BlockPool:
         return peer_id
 
     def is_caught_up(self) -> bool:
-        """reference pool.go IsCaughtUp: within 1 of the tallest peer."""
+        """reference pool.go IsCaughtUp: within 1 of the tallest peer
+        (syncing H needs H+1 for the LastCommit, hence the -1)."""
         if not self.peers:
             return False
-        return self.height >= self.max_peer_height()
+        return self.height >= self.max_peer_height() - 1

@@ -41,6 +41,8 @@ BLOCKCHAIN_CHANNEL = 0x40
 TRY_SYNC_INTERVAL = 0.01          # reference trySyncTicker (10ms)
 STATUS_UPDATE_INTERVAL = 10.0     # reference statusUpdateTicker
 SWITCH_TO_CONSENSUS_INTERVAL = 1.0
+SYNC_TIMEOUT = 60.0               # reference syncTimeout: no progress →
+                                  # give up waiting and run consensus
 BATCH_WINDOW = 16                 # blocks per device verification batch
 
 
@@ -109,7 +111,7 @@ class BlockchainReactor(Reactor):
                                   name="blockchain")]
 
     async def start(self) -> None:
-        if self.fast_sync:
+        if self.fast_sync and self._task is None:
             self._task = asyncio.get_running_loop().create_task(
                 self._pool_routine(), name="blockchain-pool")
 
@@ -119,6 +121,8 @@ class BlockchainReactor(Reactor):
         self.fast_sync = True
         self.synced.clear()
         self.pool = BlockPool(state.last_block_height + 1)
+        if self._task is not None and self._task.done():
+            self._task = None
         await self.start()
 
     async def stop(self) -> None:
@@ -197,7 +201,13 @@ class BlockchainReactor(Reactor):
                 # caught up?
                 if now - last_switch_check > SWITCH_TO_CONSENSUS_INTERVAL:
                     last_switch_check = now
-                    if self.pool.peers and self.pool.is_caught_up():
+                    stalled = self.pool.last_advance is not None and \
+                        now - self.pool.last_advance > SYNC_TIMEOUT
+                    if self.pool.is_caught_up() or stalled:
+                        if stalled and not self.pool.is_caught_up():
+                            logger.warning(
+                                "no fast-sync progress for %.0fs; "
+                                "switching to consensus", SYNC_TIMEOUT)
                         logger.info("fast sync complete at height %d "
                                     "(%d blocks)", self.pool.height - 1,
                                     self.blocks_synced)
@@ -231,6 +241,7 @@ class BlockchainReactor(Reactor):
         results = _batch_verify_window(vals, chain_id, items)
 
         applied = 0
+        now = time.monotonic()
         assumed_vals_hash = vals.hash()
         for i, err in enumerate(results):
             if err is not None:
@@ -245,7 +256,7 @@ class BlockchainReactor(Reactor):
             first = blocks[i]
             bid = items[i][0]
             parts = first.make_part_set()
-            self.pool.pop_request()
+            self.pool.pop_request(now)
             self.block_store.save_block(first, parts, blocks[i + 1].last_commit)
             self.state, _ = await self.block_exec.apply_block(
                 self.state, bid, first)

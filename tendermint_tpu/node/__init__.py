@@ -1,0 +1,293 @@
+"""Node assembly: wire every subsystem into one running service
+(reference: node/node.go:618 NewNode, :852 OnStart, :88 DefaultNewNode).
+
+Construction order mirrors the reference: stores → ABCI conns →
+handshake → mempool/evidence/executor → blockchain + consensus +
+statesync reactors → transport/switch/PEX → (optionally) statesync
+bootstrap before consensus starts. The RPC server attaches through
+`rpc_env()` once the node is built."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+from ..abci.client import ClientCreator
+from ..abci.kvstore import KVStoreApp, PersistentKVStoreApp
+from ..blockchain.reactor import BlockchainReactor
+from ..config import Config
+from ..consensus.reactor import ConsensusReactor
+from ..consensus.replay import handshake_and_load_state
+from ..consensus.state import ConsensusState
+from ..consensus.wal import WAL
+from ..evidence import Pool as EvidencePool
+from ..evidence.reactor import EvidenceReactor
+from ..libs.db import DB, FileDB, MemDB
+from ..libs.service import Service
+from ..mempool.clist_mempool import CListMempool
+from ..mempool.reactor import MempoolReactor
+from ..p2p.key import NodeKey
+from ..p2p.node_info import NodeInfo
+from ..p2p.pex.addrbook import AddrBook
+from ..p2p.pex.reactor import PEXReactor
+from ..p2p.switch import Switch
+from ..p2p.transport import Transport
+from ..privval import FilePV
+from ..proxy import AppConns
+from ..state.execution import BlockExecutor
+from ..state.store import Store
+from ..statesync.reactor import StateSyncReactor
+from ..store import BlockStore
+from ..types.events import EventBus
+from ..types.genesis import GenesisDoc
+
+logger = logging.getLogger("node")
+
+
+def default_app_creator(config: Config):
+    """reference: proxy.DefaultClientCreator — builtin kvstore or a
+    socket to an external app."""
+    name = config.base.proxy_app
+    if config.base.abci == "builtin" or name in ("kvstore", "counter",
+                                                 "noop"):
+        if name == "kvstore":
+            data_dir = config.base.resolve(config.base.db_dir)
+            os.makedirs(data_dir, exist_ok=True)
+            db = FileDB(os.path.join(data_dir, "app.db"))
+            return ClientCreator(app=PersistentKVStoreApp(db))
+        if name == "counter":
+            from ..abci.counter import CounterApp
+
+            return ClientCreator(app=CounterApp())
+        if name == "noop":
+            return ClientCreator(app=KVStoreApp())
+        raise ValueError(f"unknown builtin app {name!r}")
+    if name.startswith("unix://"):
+        return ClientCreator(unix_path=name[len("unix://"):])
+    addr = name[len("tcp://"):] if name.startswith("tcp://") else name
+    host, _, port = addr.rpartition(":")
+    return ClientCreator(addr=(host or "127.0.0.1", int(port)))
+
+
+def _db(config: Config, name: str, in_memory: bool) -> DB:
+    if in_memory:
+        return MemDB()
+    d = config.base.resolve(config.base.db_dir)
+    os.makedirs(d, exist_ok=True)
+    return FileDB(os.path.join(d, f"{name}.db"))
+
+
+def _split_laddr(laddr: str) -> tuple[str, int]:
+    addr = laddr[len("tcp://"):] if laddr.startswith("tcp://") else laddr
+    host, _, port = addr.rpartition(":")
+    return host or "0.0.0.0", int(port)
+
+
+class Node(Service):
+    """reference: node/node.go Node."""
+
+    def __init__(self, config: Config,
+                 priv_validator=None,
+                 node_key: NodeKey | None = None,
+                 genesis_doc: GenesisDoc | None = None,
+                 client_creator: ClientCreator | None = None,
+                 state_provider_factory=None,
+                 in_memory: bool = False):
+        super().__init__(name=f"node.{config.base.moniker}")
+        self.config = config
+        self.genesis_doc = genesis_doc or GenesisDoc.load(
+            config.base.resolve(config.base.genesis_file))
+        self.node_key = node_key or NodeKey.load_or_gen(
+            config.base.resolve(config.base.node_key_file))
+        self.priv_validator = priv_validator
+        self.client_creator = client_creator or default_app_creator(config)
+        self.state_provider_factory = state_provider_factory
+        self.in_memory = in_memory
+        self._built = False
+
+    @classmethod
+    def default_new_node(cls, config: Config) -> "Node":
+        """reference: node/node.go:88 DefaultNewNode — file-backed
+        keys + builtin app."""
+        pv = FilePV.load_or_generate(
+            config.base.resolve(config.base.priv_validator_key_file),
+            config.base.resolve(config.base.priv_validator_state_file))
+        return cls(config, priv_validator=pv)
+
+    # -- assembly (reference NewNode body) --
+
+    async def _build(self) -> None:
+        cfg = self.config
+        self.block_store = BlockStore(_db(cfg, "blockstore",
+                                          self.in_memory))
+        self.state_store = Store(_db(cfg, "state", self.in_memory))
+        self.event_bus = EventBus()
+
+        self.proxy_app = AppConns(self.client_creator)
+        await self.proxy_app.start()
+
+        self.state = await handshake_and_load_state(
+            None, self.state_store, self.block_store, self.genesis_doc,
+            self.proxy_app)
+
+        self.evpool = EvidencePool(_db(cfg, "evidence", self.in_memory),
+                                   self.state_store, self.block_store)
+        from ..state.txindex import IndexerService, TxIndexer
+
+        self.tx_indexer = TxIndexer(_db(cfg, "txindex", self.in_memory))
+        self.indexer_service = IndexerService(self.tx_indexer,
+                                              self.event_bus)
+        self.mempool = CListMempool(cfg.mempool, self.proxy_app.mempool,
+                                    height=self.state.last_block_height)
+        self.block_exec = BlockExecutor(
+            self.state_store, self.proxy_app.consensus,
+            mempool=self.mempool, evidence_pool=self.evpool,
+            event_bus=self.event_bus)
+
+        wal_path = cfg.base.resolve(cfg.consensus.wal_file)
+        os.makedirs(os.path.dirname(wal_path), exist_ok=True)
+        self.consensus_state = ConsensusState(
+            cfg.consensus, self.state, self.block_exec, self.block_store,
+            mempool=self.mempool, evpool=self.evpool,
+            wal=None if self.in_memory else WAL(wal_path),
+            event_bus=self.event_bus)
+        if self.priv_validator is not None:
+            self.consensus_state.set_priv_validator(self.priv_validator)
+
+        state_sync = cfg.statesync.enable and \
+            self.state.last_block_height == 0
+        wait_sync = cfg.base.fast_sync or state_sync
+        self.consensus_reactor = ConsensusReactor(
+            self.consensus_state, wait_sync=wait_sync,
+            gossip_sleep=cfg.consensus.peer_gossip_sleep_ms / 1000.0)
+        self.bc_reactor = BlockchainReactor(
+            self.state, self.block_exec, self.block_store,
+            fast_sync=cfg.base.fast_sync and not state_sync,
+            consensus_reactor=self.consensus_reactor)
+        self.mempool_reactor = MempoolReactor(
+            self.mempool, broadcast=cfg.mempool.broadcast)
+        self.ev_reactor = EvidenceReactor(self.evpool)
+        provider = (self.state_provider_factory(self)
+                    if state_sync and self.state_provider_factory else None)
+        self.ss_reactor = StateSyncReactor(
+            self.proxy_app.snapshot, provider,
+            discovery_time=cfg.statesync.discovery_time_s)
+        self._state_sync = state_sync and provider is not None
+
+        # p2p
+        holder = {}
+
+        def node_info() -> NodeInfo:
+            t = holder.get("transport")
+            addr = cfg.p2p.external_address or \
+                (t.listen_addr if t is not None and t._server else "")
+            return NodeInfo(
+                node_id=self.node_key.id, listen_addr=addr,
+                network=self.genesis_doc.chain_id,
+                moniker=cfg.base.moniker,
+                channels=bytes([0x00, 0x20, 0x21, 0x22, 0x23, 0x30,
+                                0x38, 0x40, 0x60, 0x61]))
+
+        self.transport = Transport(
+            self.node_key, node_info,
+            handshake_timeout=cfg.p2p.handshake_timeout_s,
+            dial_timeout=cfg.p2p.dial_timeout_s)
+        holder["transport"] = self.transport
+        self.switch = Switch(self.transport, node_info,
+                             max_inbound=cfg.p2p.max_num_inbound_peers,
+                             max_outbound=cfg.p2p.max_num_outbound_peers)
+        self.switch.add_reactor("consensus", self.consensus_reactor)
+        self.switch.add_reactor("blockchain", self.bc_reactor)
+        self.switch.add_reactor("mempool", self.mempool_reactor)
+        self.switch.add_reactor("evidence", self.ev_reactor)
+        self.switch.add_reactor("statesync", self.ss_reactor)
+        if cfg.p2p.pex:
+            book_path = None if self.in_memory else \
+                cfg.base.resolve("config/addrbook.json")
+            self.addr_book = AddrBook(book_path)
+            self.pex_reactor = PEXReactor(
+                self.addr_book,
+                seeds=[s for s in cfg.p2p.seeds.split(",") if s],
+                seed_mode=cfg.p2p.seed_mode)
+            self.switch.add_reactor("pex", self.pex_reactor)
+        self._built = True
+
+    # -- lifecycle (reference OnStart node.go:852) --
+
+    async def on_start(self) -> None:
+        if not self._built:
+            await self._build()
+        cfg = self.config
+        self.indexer_service.start()
+        # RPC first, so operators can inspect a node that hangs during
+        # sync (reference node.go:865 starts RPC before the switch)
+        self.rpc_server = None
+        if cfg.rpc.laddr:
+            from ..rpc.core import serve
+
+            rhost, rport = _split_laddr(cfg.rpc.laddr)
+            self.rpc_server, self.rpc_port = await serve(
+                self.rpc_env(), rhost, rport)
+        host, port = _split_laddr(cfg.p2p.laddr)
+        await self.transport.listen(host, port)
+        await self.switch.start()
+        persistent = [p for p in cfg.p2p.persistent_peers.split(",") if p]
+        if persistent:
+            self.switch.add_persistent_peers(persistent)
+            self.spawn(self.switch.dial_peers_async(persistent,
+                                                    persistent=True),
+                       "dial-persistent")
+        # switch.start() already started every reactor (incl. the
+        # fast-sync pool when enabled); what remains is deciding how
+        # consensus comes up
+        if self._state_sync:
+            self.spawn(self._run_state_sync(), "state-sync")
+        elif not self.bc_reactor.fast_sync:
+            await self.consensus_state.start()
+
+    async def _run_state_sync(self) -> None:
+        """Snapshot-restore, then fast-sync the tail
+        (reference: node.go:561 startStateSync)."""
+        try:
+            state, commit = await self.ss_reactor.sync()
+            self.state_store.bootstrap(state)
+            self.block_store.save_seen_commit(state.last_block_height,
+                                              commit)
+            self.state = state
+            await self.bc_reactor.switch_to_fast_sync(state)
+            logger.info("state sync done at height %d; fast-syncing tail",
+                        state.last_block_height)
+        except Exception:
+            logger.exception("state sync failed")
+
+    async def on_stop(self) -> None:
+        if self.rpc_server is not None:
+            self.rpc_server.close()
+        self.indexer_service.stop()
+        if self.consensus_state.is_running:
+            await self.consensus_state.stop()
+        for r in ("bc_reactor", "mempool_reactor", "ev_reactor"):
+            await getattr(self, r).stop()
+        await self.consensus_reactor.stop()
+        if hasattr(self, "pex_reactor"):
+            await self.pex_reactor.stop()
+        await self.switch.stop()
+        await self.proxy_app.stop()
+
+    # -- conveniences --
+
+    @property
+    def listen_addr(self) -> str:
+        return self.transport.listen_addr
+
+    @property
+    def p2p_addr(self) -> str:
+        return f"{self.node_key.id}@{self.transport.listen_addr}"
+
+    def rpc_env(self):
+        """Handles the RPC layer binds to (reference: rpc/core/env.go:68
+        Environment)."""
+        from ..rpc.core import Environment
+
+        return Environment(self)

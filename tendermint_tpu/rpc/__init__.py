@@ -1,0 +1,6 @@
+"""JSON-RPC API layer (reference: rpc/).
+
+Server: HTTP POST JSON-RPC 2.0, GET URI routes, and WebSocket
+subscriptions, all on one listener (reference rpc/jsonrpc/server/).
+Routes: reference rpc/core/routes.go:10-47. Clients: HTTP + WebSocket
+(reference rpc/client/)."""

@@ -1,0 +1,168 @@
+"""Transaction indexer (reference: state/txindex/).
+
+IndexerService subscribes to the EventBus Tx stream and writes each
+TxResult into a kv index: primary record by tx hash, secondary keys
+for height and for every ABCI event attribute (`type.key=value`), so
+`tx_search` can answer the same query language the pubsub uses."""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass
+
+from ..crypto import tmhash
+from ..libs.pubsub import Query
+from ..types.events import EventDataTx, query_for_event
+
+logger = logging.getLogger("txindex")
+
+_PRIMARY = b"tx/"
+_BY_HEIGHT = b"txh/"
+_BY_EVENT = b"txe/"
+
+
+@dataclass
+class TxResult:
+    height: int
+    index: int
+    tx: bytes
+    result: dict
+
+    def hash(self) -> bytes:
+        return tmhash.sum256(self.tx)
+
+
+class TxIndexer:
+    """kv indexer (reference: state/txindex/kv/kv.go)."""
+
+    def __init__(self, db):
+        self.db = db
+
+    def index(self, tr: TxResult) -> None:
+        h = tr.hash()
+        payload = json.dumps({
+            "height": tr.height, "index": tr.index,
+            "tx": tr.tx.hex(), "result": tr.result,
+        }).encode()
+        ops = [(_PRIMARY + h, payload),
+               (_BY_HEIGHT + _u64(tr.height) + _u32(tr.index) + h, b"")]
+        for ev in tr.result.get("events", []):
+            etype = ev.get("type", "")
+            for attr in ev.get("attributes", []):
+                k, v = attr.get("key", ""), attr.get("value", "")
+                if not etype or not k:
+                    continue
+                composite = f"{etype}.{k}={v}".encode()
+                ops.append((_BY_EVENT + composite + b"/" +
+                            _u64(tr.height) + _u32(tr.index) + h, b""))
+        self.db.write_batch(ops)
+
+    def get(self, tx_hash: bytes) -> TxResult | None:
+        raw = self.db.get(_PRIMARY + tx_hash)
+        if raw is None:
+            return None
+        d = json.loads(raw)
+        return TxResult(d["height"], d["index"],
+                        bytes.fromhex(d["tx"]), d["result"])
+
+    def search(self, query: Query) -> list[TxResult]:
+        """Equality conditions narrow via the secondary indexes and are
+        intersected; every other operator (ranges, CONTAINS, EXISTS) is
+        applied as a post-filter. A query with no equality condition
+        scans the primary records (reference kv.go Search)."""
+        candidate_sets: list[set[bytes]] = []
+        for cond in query.conditions:
+            if cond.op != "=":
+                continue
+            if cond.key == "tx.height":
+                hashes = {
+                    k[-32:] for k, _ in self.db.iterate_prefix(
+                        _BY_HEIGHT + _u64(int(cond.value)))
+                }
+            else:
+                composite = f"{cond.key}={cond.value}".encode()
+                hashes = {
+                    k[-32:] for k, _ in self.db.iterate_prefix(
+                        _BY_EVENT + composite + b"/")
+                }
+            candidate_sets.append(hashes)
+        if candidate_sets:
+            hits = set.intersection(*candidate_sets)
+        else:
+            hits = {k[len(_PRIMARY):]
+                    for k, _ in self.db.iterate_prefix(_PRIMARY)}
+        out = [self.get(h) for h in sorted(hits)]
+        results = [t for t in out if t is not None]
+        for cond in query.conditions:
+            if cond.op == "=":
+                continue
+            results = [
+                t for t in results
+                if cond.matches({cond.key: vals} if
+                                (vals := _attr_values(t, cond)) else {})
+            ]
+        results.sort(key=lambda t: (t.height, t.index))
+        return results
+
+
+def _attr_values(tr: TxResult, cond) -> list[str]:
+    if cond.key == "tx.height":
+        return [str(tr.height)]
+    if cond.key == "tx.hash":
+        return [tr.hash().hex().upper()]
+    out = []
+    for ev in tr.result.get("events", []):
+        for attr in ev.get("attributes", []):
+            if f"{ev.get('type')}.{attr.get('key')}" == cond.key:
+                out.append(attr.get("value", ""))
+    return out
+
+
+def _u64(v: int) -> bytes:
+    return v.to_bytes(8, "big")
+
+
+def _u32(v: int) -> bytes:
+    return v.to_bytes(4, "big")
+
+
+class IndexerService:
+    """Bridges EventBus → TxIndexer
+    (reference: state/txindex/indexer_service.go)."""
+
+    SUBSCRIBER = "tx-indexer"
+
+    def __init__(self, indexer: TxIndexer, event_bus):
+        self.indexer = indexer
+        self.event_bus = event_bus
+
+    def start(self) -> None:
+        import asyncio
+
+        self._sub = self.event_bus.subscribe(self.SUBSCRIBER,
+                                             query_for_event("Tx"))
+        self._task = asyncio.get_running_loop().create_task(
+            self._run(), name="tx-indexer")
+
+    def stop(self) -> None:
+        self.event_bus.unsubscribe_all(self.SUBSCRIBER)
+        if getattr(self, "_task", None) is not None:
+            self._task.cancel()
+
+    async def _run(self) -> None:
+        import asyncio
+
+        while True:
+            try:
+                msg = await self._sub.next()
+            except asyncio.CancelledError:
+                return
+            data = msg.data
+            if isinstance(data, EventDataTx):
+                try:
+                    self.indexer.index(TxResult(data.height, data.index,
+                                                data.tx, data.result))
+                except Exception:
+                    logger.exception("failed to index tx at height %d",
+                                     data.height)

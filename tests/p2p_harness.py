@@ -92,8 +92,7 @@ class P2PNode:
         self.switch.add_reactor("evidence", self.ev_reactor)
         self.switch.add_reactor("statesync", self.ss_reactor)
         await transport.listen("127.0.0.1", 0)
-        await self.switch.start()
-        await self.bc_reactor.start()
+        await self.switch.start()  # starts every reactor, bc pool incl.
         if not wait_sync:
             await self.cs.start()
 

@@ -1,0 +1,205 @@
+"""RPC layer: HTTP JSON-RPC + URI routes + WebSocket subscriptions
+against a live node (reference: rpc/client interface tests +
+rpc/jsonrpc tests)."""
+
+import asyncio
+import base64
+import json
+
+import pytest
+
+from tendermint_tpu.config import Config, fast_consensus_config
+from tendermint_tpu.node import Node
+from tendermint_tpu.privval import FilePV
+from tendermint_tpu.rpc.jsonrpc import HTTPClient, RPCError, WSClient
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+from helpers import GENESIS_TIME
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def start_node(tmp_path):
+    import os
+
+    home = str(tmp_path / "rpcnode")
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    pv = FilePV.generate()
+    gdoc = GenesisDoc(chain_id="rpc-chain", genesis_time=GENESIS_TIME,
+                      validators=[GenesisValidator(pv.get_pub_key(), 10)])
+    gdoc.validate_and_complete()
+    cfg = Config()
+    cfg.base.home = home
+    cfg.base.moniker = "rpc-node"
+    cfg.base.fast_sync = False
+    cfg.consensus = fast_consensus_config()
+    cfg.consensus.wal_file = "data/cs.wal/wal"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    gdoc.save(os.path.join(home, "config", "genesis.json"))
+    pv.key_path = cfg.base.resolve(cfg.base.priv_validator_key_file)
+    pv.state_path = cfg.base.resolve(cfg.base.priv_validator_state_file)
+    pv.save_key()
+    node = Node.default_new_node(cfg)
+    await node.start()
+    return node
+
+
+def test_rpc_surface(tmp_path):
+    async def go():
+        node = await start_node(tmp_path)
+        try:
+            await node.consensus_state.wait_for_height(2, timeout=60)
+            cli = HTTPClient("127.0.0.1", node.rpc_port)
+
+            assert await cli.call("health") == {}
+
+            st = await cli.call("status")
+            assert st["node_info"]["network"] == "rpc-chain"
+            assert int(st["sync_info"]["latest_block_height"]) >= 2
+            assert st["validator_info"]["voting_power"] == "10"
+
+            ni = await cli.call("net_info")
+            assert ni["n_peers"] == "0"
+
+            g = await cli.call("genesis")
+            assert g["genesis"]["chain_id"] == "rpc-chain"
+
+            b = await cli.call("block", height=2)
+            assert b["block"]["header"]["height"] == "2"
+            assert b["block_id"]["hash"]
+
+            # block_by_hash round-trips
+            bh = await cli.call("block_by_hash", hash=b["block_id"]["hash"])
+            assert bh["block"]["header"]["height"] == "2"
+
+            bc = await cli.call("blockchain", min_height=1, max_height=2)
+            assert len(bc["block_metas"]) == 2
+
+            cm = await cli.call("commit", height=2)
+            assert cm["signed_header"]["commit"]["height"] == "2"
+
+            vals = await cli.call("validators", height=2)
+            assert vals["total"] == "1"
+            assert vals["validators"][0]["voting_power"] == "10"
+
+            cp = await cli.call("consensus_params", height=2)
+            assert int(cp["consensus_params"]["block"]["max_bytes"]) > 0
+
+            cs = await cli.call("consensus_state")
+            assert int(cs["round_state"]["height"]) >= 2
+
+            ai = await cli.call("abci_info")
+            assert int(ai["response"]["last_block_height"]) >= 1
+
+            with pytest.raises(RPCError):
+                await cli.call("block", height=10_000)
+            with pytest.raises(RPCError):
+                await cli.call("no_such_method")
+
+            # tx lifecycle: commit → query → index → search
+            tx = b"rpckey=rpcval"
+            res = await cli.call("broadcast_tx_commit",
+                                 tx=base64.b64encode(tx).decode())
+            assert res["deliver_tx"]["code"] == 0
+            tx_height = int(res["height"])
+            tx_hash = res["hash"]
+
+            q = await cli.call("abci_query", path="",
+                               data=b"rpckey".hex())
+            assert base64.b64decode(q["response"]["value"]) == b"rpcval"
+
+            got = await cli.call("tx", hash=tx_hash, prove=True)
+            assert got["height"] == str(tx_height)
+            assert base64.b64decode(got["tx"]) == tx
+            assert got["proof"]["root_hash"]
+
+            found = await cli.call("tx_search",
+                                   query=f"tx.height = {tx_height}")
+            assert found["total_count"] == "1"
+            assert base64.b64decode(found["txs"][0]["tx"]) == tx
+
+            br = await cli.call("block_results", height=tx_height)
+            assert br["txs_results"][0]["code"] == 0
+
+            nut = await cli.call("num_unconfirmed_txs")
+            assert nut["n_txs"] == "0"
+        finally:
+            await node.stop()
+
+    run(go())
+
+
+def test_rpc_uri_and_batch(tmp_path):
+    async def go():
+        node = await start_node(tmp_path)
+        try:
+            await node.consensus_state.wait_for_height(2, timeout=60)
+            # raw HTTP GET (URI route) and a JSON-RPC batch
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", node.rpc_port)
+            writer.write(b"GET /block?height=1 HTTP/1.1\r\n"
+                         b"Host: x\r\nConnection: close\r\n\r\n")
+            await writer.drain()
+            raw = await reader.read(-1)
+            writer.close()
+            _, _, body = raw.partition(b"\r\n\r\n")
+            resp = json.loads(body)
+            assert resp["result"]["block"]["header"]["height"] == "1"
+
+            batch = json.dumps([
+                {"jsonrpc": "2.0", "id": 1, "method": "health",
+                 "params": {}},
+                {"jsonrpc": "2.0", "id": 2, "method": "status",
+                 "params": {}},
+            ]).encode()
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", node.rpc_port)
+            writer.write(b"POST / HTTP/1.1\r\nHost: x\r\n"
+                         b"Content-Type: application/json\r\n"
+                         b"Connection: close\r\n"
+                         b"Content-Length: " + str(len(batch)).encode() +
+                         b"\r\n\r\n" + batch)
+            await writer.drain()
+            raw = await reader.read(-1)
+            writer.close()
+            _, _, body = raw.partition(b"\r\n\r\n")
+            out = json.loads(body)
+            assert isinstance(out, list) and len(out) == 2
+            assert out[1]["result"]["node_info"]["moniker"] == "rpc-node"
+        finally:
+            await node.stop()
+
+    run(go())
+
+
+def test_ws_subscription(tmp_path):
+    async def go():
+        node = await start_node(tmp_path)
+        try:
+            await node.consensus_state.wait_for_height(1, timeout=60)
+            ws = WSClient("127.0.0.1", node.rpc_port)
+            await ws.connect()
+            try:
+                await ws.call("subscribe",
+                              query="tm.event = 'NewBlock'")
+                ev = await asyncio.wait_for(ws.events.get(), 30)
+                data = ev["result"]["data"]
+                assert data["type"] == "NewBlock"
+                h1 = int(data["block"]["header"]["height"])
+                ev2 = await asyncio.wait_for(ws.events.get(), 30)
+                h2 = int(ev2["result"]["data"]["block"]["header"]["height"])
+                assert h2 == h1 + 1
+                await ws.call("unsubscribe",
+                              query="tm.event = 'NewBlock'")
+                # status also works over the websocket
+                st = await ws.call("status")
+                assert st["node_info"]["moniker"] == "rpc-node"
+            finally:
+                ws.close()
+        finally:
+            await node.stop()
+
+    run(go())
