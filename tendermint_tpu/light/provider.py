@@ -28,6 +28,46 @@ class Provider:
         return repr(self)
 
 
+class RPCProvider(Provider):
+    """Fetches light blocks from a node's JSON-RPC `commit` +
+    `validators` routes (reference: light/provider/http)."""
+
+    def __init__(self, host: str, port: int, name: str = ""):
+        from ..rpc.jsonrpc import HTTPClient
+
+        self.client = HTTPClient(host, port)
+        self.name = name or f"{host}:{port}"
+
+    def provider_id(self) -> str:
+        return self.name
+
+    async def light_block(self, height: int) -> LightBlock:
+        from ..rpc.core import (
+            commit_from_json, header_from_json, validator_set_from_json,
+        )
+        from ..rpc.jsonrpc import RPCError
+
+        try:
+            params = {} if height == 0 else {"height": height}
+            cm = await self.client.call("commit", **params)
+            header = header_from_json(cm["signed_header"]["header"])
+            commit = commit_from_json(cm["signed_header"]["commit"])
+            vals_pages = []
+            page = 1
+            while True:
+                v = await self.client.call("validators",
+                                           height=header.height,
+                                           page=page, per_page=100)
+                vals_pages.extend(v["validators"])
+                if len(vals_pages) >= int(v["total"]):
+                    break
+                page += 1
+            vals = validator_set_from_json(vals_pages)
+        except RPCError as e:
+            raise BlockNotFoundError(str(e)) from e
+        return LightBlock(SignedHeader(header, commit), vals)
+
+
 class BlockStoreProvider(Provider):
     """Serves from a full node's block store + state store
     (reference: the local rpc core behaviour light clients hit)."""

@@ -581,6 +581,66 @@ def _event_json(data) -> dict:
     return out
 
 
+# --- JSON → types (for the RPC light provider) --------------------------------
+
+
+def header_from_json(d: dict):
+    from ..types.block import BlockID, Header, PartSetHeader
+
+    def _bid(j):
+        if not j or not j.get("hash"):
+            return None
+        return BlockID(bytes.fromhex(j["hash"]),
+                       PartSetHeader(j["parts"]["total"],
+                                     bytes.fromhex(j["parts"]["hash"])
+                                     if j["parts"]["hash"] else b""))
+
+    return Header(
+        version_block=d["version"]["block"],
+        version_app=d["version"]["app"],
+        chain_id=d["chain_id"], height=int(d["height"]),
+        time=int(d["time"]), last_block_id=_bid(d["last_block_id"]),
+        last_commit_hash=bytes.fromhex(d["last_commit_hash"]),
+        data_hash=bytes.fromhex(d["data_hash"]),
+        validators_hash=bytes.fromhex(d["validators_hash"]),
+        next_validators_hash=bytes.fromhex(d["next_validators_hash"]),
+        consensus_hash=bytes.fromhex(d["consensus_hash"]),
+        app_hash=bytes.fromhex(d["app_hash"]),
+        last_results_hash=bytes.fromhex(d["last_results_hash"]),
+        evidence_hash=bytes.fromhex(d["evidence_hash"]),
+        proposer_address=bytes.fromhex(d["proposer_address"]),
+    )
+
+
+def commit_from_json(d: dict):
+    from ..types.block import BlockID, Commit, CommitSig, PartSetHeader
+
+    bid_j = d["block_id"]
+    bid = BlockID(bytes.fromhex(bid_j["hash"]),
+                  PartSetHeader(bid_j["parts"]["total"],
+                                bytes.fromhex(bid_j["parts"]["hash"])))
+    sigs = [CommitSig(
+        block_id_flag=s["block_id_flag"],
+        validator_address=bytes.fromhex(s["validator_address"]),
+        timestamp=int(s["timestamp"]),
+        signature=base64.b64decode(s["signature"]),
+    ) for s in d["signatures"]]
+    return Commit(int(d["height"]), d["round"], bid, sigs)
+
+
+def validator_set_from_json(vals_json: list):
+    from ..crypto.ed25519 import Ed25519PubKey
+    from ..types.validator import Validator
+    from ..types.validator_set import ValidatorSet
+
+    vals = []
+    for v in vals_json:
+        pk = Ed25519PubKey(base64.b64decode(v["pub_key"]["value"]))
+        vals.append(Validator(pk.address(), pk, int(v["voting_power"]),
+                              int(v.get("proposer_priority", 0))))
+    return ValidatorSet(vals)
+
+
 async def serve(env: Environment, host: str, port: int):
     """Build the server and start listening; returns (server, port)."""
     from .jsonrpc import JSONRPCServer
