@@ -245,13 +245,25 @@ class BlockchainReactor(Reactor):
         assumed_vals_hash = vals.hash()
         for i, err in enumerate(results):
             if err is not None:
-                peer_id = self.pool.redo_request(items[i][1])
-                logger.warning("block %d failed verification (%s); "
-                               "banning peer %s", items[i][1], err, peer_id)
+                # The failure implicates BOTH peers: the one that served
+                # block H (possibly forged) and the one that served
+                # block H+1 carrying the LastCommit used to verify H
+                # (possibly forged commit). Redo + ban both, mirroring
+                # reference blockchain/v0/reactor.go:409 — otherwise a
+                # byzantine peer serving H+1 with a bad commit keeps its
+                # block buffered while honest H-servers get banned one
+                # by one, stalling the sync.
+                bad_heights = (items[i][1], blocks[i + 1].header.height)
                 sw = self.switch
-                if sw is not None and peer_id in sw.peers:
-                    sw._on_peer_error(sw.peers[peer_id],
-                                      RuntimeError(f"bad block: {err}"))
+                for h in bad_heights:
+                    peer_id = self.pool.redo_request(h)
+                    logger.warning(
+                        "block %d failed verification (%s); banning "
+                        "peer %s", h, err, peer_id,
+                    )
+                    if sw is not None and peer_id in sw.peers:
+                        sw._on_peer_error(sw.peers[peer_id],
+                                          RuntimeError(f"bad block: {err}"))
                 break
             first = blocks[i]
             bid = items[i][0]

@@ -127,6 +127,13 @@ class ConsensusConfig:
     double_sign_check_height: int = 0
     peer_gossip_sleep_ms: int = 100
     peer_query_maj23_sleep_ms: int = 2000
+    # Micro-batch vote verification (this framework's TPU hot path —
+    # no reference equivalent): incoming votes accumulate for up to
+    # vote_batch_window_ms (or until vote_batch_max) and are verified
+    # as one device batch off the event loop; 0 disables batching and
+    # verifies each vote synchronously like the reference.
+    vote_batch_window_ms: float = 2.0
+    vote_batch_max: int = 1024
 
     def propose_timeout(self, round_: int) -> float:
         return (self.timeout_propose_ms
@@ -246,6 +253,8 @@ class Config:
                     setattr(section, key, val == "true")
                 elif fld.type in ("int", int):
                     setattr(section, key, int(val))
+                elif fld.type in ("float", float):
+                    setattr(section, key, float(val))
                 elif fld.type.startswith("list") if isinstance(fld.type, str) else False:
                     s = val.strip('"')
                     setattr(section, key, [x for x in s.split(",") if x])

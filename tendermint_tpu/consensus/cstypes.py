@@ -102,10 +102,13 @@ class HeightVoteSet:
         rs = self._round_vote_sets.get(round_)
         return rs[1] if rs else None
 
-    def add_vote(self, vote: Vote, peer_id: str = "") -> bool:
+    def add_vote(self, vote: Vote, peer_id: str = "",
+                 verify: bool = True) -> bool:
         """Route to the right round's VoteSet. Votes from rounds beyond
         round+1 are only admitted once per peer (catchup; DoS bound,
-        reference height_vote_set.go AddVote)."""
+        reference height_vote_set.go AddVote). verify=False commits a
+        vote whose signature the micro-batch scheduler already checked
+        on device."""
         if not VoteType.is_valid(int(vote.type)):
             raise VoteSetError("invalid vote type")
         vs = self._get(vote.round, vote.type)
@@ -119,7 +122,7 @@ class HeightVoteSet:
                 raise VoteSetError(
                     f"unwanted round {vote.round} from peer {peer_id}"
                 )
-        return vs.add_vote(vote)
+        return vs.add_vote(vote, verify=verify)
 
     def _get(self, round_: int, type_: VoteType) -> VoteSet | None:
         return (self.prevotes(round_) if type_ == VoteType.PREVOTE

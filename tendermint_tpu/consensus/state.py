@@ -70,6 +70,15 @@ class ConsensusState(Service):
         self.internal_msg_queue: asyncio.Queue[_QueuedMsg] = asyncio.Queue(1000)
         self.ticker = TimeoutTicker()
         self._replay_mode = False
+        # Serializes state transitions between the receive routine and
+        # the vote micro-batch scheduler (the analogue of reference
+        # cs.mtx — asyncio tasks interleave at awaits, and step
+        # transitions contain awaits).
+        self._state_mtx = asyncio.Lock()
+        # Vote micro-batch scheduler buffers (SURVEY §7 latency budget):
+        # (vote, peer_id, pub_key) triples awaiting one device batch.
+        self._vote_buf: list = []
+        self._vote_pending = asyncio.Event()
         self._height_done = asyncio.Event()  # pulsed on every commit
         # reactor hooks: fn(event_name, payload); events: "step",
         # "proposal", "block_part", "vote", "has_vote"
@@ -97,6 +106,8 @@ class ConsensusState(Service):
         if self.wal is not None:
             await self._catchup_replay()
         self.spawn(self._receive_routine(), name="cs-receive")
+        if self.config.vote_batch_window_ms > 0:
+            self.spawn(self._vote_scheduler(), name="cs-vote-batch")
         self._schedule_round0()
 
     async def on_stop(self) -> None:
@@ -165,10 +176,11 @@ class ConsensusState(Service):
             self.state.chain_id, seen.height, seen.round,
             VoteType.PRECOMMIT, self.state.last_validators,
         )
+        votes = []
         for idx, cs_sig in enumerate(seen.signatures):
             if cs_sig.is_absent():
                 continue
-            vote = Vote(
+            votes.append(Vote(
                 type=VoteType.PRECOMMIT,
                 height=seen.height,
                 round=seen.round,
@@ -177,8 +189,25 @@ class ConsensusState(Service):
                 validator_address=cs_sig.validator_address,
                 validator_index=idx,
                 signature=cs_sig.signature,
-            )
-            last_precommits.add_vote(vote)
+            ))
+        # One device batch for the whole stored commit instead of a
+        # per-signature host loop (this is our own store, but the
+        # reference verifies here too — state.go:549 via AddVote).
+        from ..crypto.batch import BatchVerifier
+
+        bv = BatchVerifier()
+        vals = self.state.last_validators
+        for v in votes:
+            val = vals.get_by_index(v.validator_index)
+            bv.add(val.pub_key, v.sign_bytes(self.state.chain_id), v.signature)
+        _, verdicts = bv.verify()
+        for v, ok in zip(votes, verdicts):
+            if not ok:
+                raise RuntimeError(
+                    f"invalid signature in seen commit (val index "
+                    f"{v.validator_index})"
+                )
+            last_precommits.add_vote(v, verify=False)
         if not last_precommits.has_two_thirds_majority():
             raise RuntimeError("seen commit lacks +2/3")
         self.rs.last_commit = last_precommits
@@ -202,17 +231,20 @@ class ConsensusState(Service):
                     self._wal_write_sync(MsgInfo(
                         "", m.encode_consensus_msg(qm.msg)
                     ))
-                    await self._handle_msg(qm)
+                    async with self._state_mtx:
+                        await self._handle_msg(qm)
                 if peer in done:
                     qm = peer.result()
                     self._wal_write(MsgInfo(
                         qm.peer_id, m.encode_consensus_msg(qm.msg)
                     ))
-                    await self._handle_msg(qm)
+                    async with self._state_mtx:
+                        await self._handle_msg(qm)
                 if timeout in done:
                     ti = timeout.result()
                     self._wal_write_sync(ti)
-                    await self._handle_timeout(ti)
+                    async with self._state_mtx:
+                        await self._handle_timeout(ti)
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -261,7 +293,9 @@ class ConsensusState(Service):
             elif added and self.rs.proposal_complete():
                 await self._proposal_completed()
         elif isinstance(msg, m.VoteMessage):
-            await self._try_add_vote(msg.vote, qm.peer_id)
+            if (self._replay_mode or self.config.vote_batch_window_ms <= 0
+                    or not self._enqueue_vote(msg.vote, qm.peer_id)):
+                await self._try_add_vote(msg.vote, qm.peer_id)
         else:
             self.logger.warning("unknown consensus msg %r", type(msg))
 
@@ -422,7 +456,8 @@ class ConsensusState(Service):
             await self._sign_add_vote(VoteType.PREVOTE, b"", None)
         else:
             try:
-                self.block_exec.validate_block(self.state, rs.proposal_block)
+                await self.block_exec.validate_block_async(
+                    self.state, rs.proposal_block)
                 await self._sign_add_vote(
                     VoteType.PREVOTE, rs.proposal_block.hash(),
                     rs.proposal_block_parts.header(),
@@ -480,7 +515,8 @@ class ConsensusState(Service):
             return
         if rs.proposal_block is not None and rs.proposal_block.hash() == bid.hash:
             try:
-                self.block_exec.validate_block(self.state, rs.proposal_block)
+                await self.block_exec.validate_block_async(
+                    self.state, rs.proposal_block)
             except Exception as e:
                 self.logger.error("polka for invalid block: %r", e)
                 await self._sign_add_vote(VoteType.PRECOMMIT, b"", None)
@@ -597,10 +633,36 @@ class ConsensusState(Service):
             except Exception as e:
                 self.logger.error("prune failed: %r", e)
 
+        self._record_commit_metrics(block, precommits)
         self.update_to_state(new_state)
         self._height_done.set()
         self._height_done = asyncio.Event()
         self._schedule_round0()
+
+    def _record_commit_metrics(self, block, precommits) -> None:
+        """reference consensus/metrics.go recording (state.go:1612
+        recordMetrics)."""
+        from ..libs.metrics import consensus_metrics
+
+        met = consensus_metrics()
+        met.height.set(block.header.height)
+        met.rounds.set(self.rs.round)
+        vals = self.rs.validators
+        met.validators.set(len(vals))
+        met.validators_power.set(vals.total_voting_power())
+        missing = sum(
+            1 for i in range(len(vals)) if precommits.get_by_index(i) is None
+        )
+        met.missing_validators.set(missing)
+        ntx = len(block.data.txs)
+        met.num_txs.set(ntx)
+        met.total_txs.inc(ntx)
+        met.block_size_bytes.set(len(block.to_bytes()))
+        prev = self.block_store.load_block_meta(block.header.height - 1)
+        if prev is not None:
+            met.block_interval_seconds.observe(
+                max(block.header.time - prev.header.time, 0) / 1e9
+            )
 
     # -- proposals & parts --
 
@@ -653,12 +715,110 @@ class ConsensusState(Service):
 
     # -- votes --
 
-    async def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+    # -- vote micro-batch scheduler --
+    #
+    # The TPU latency-budget restructuring SURVEY §7 names: votes are
+    # not verified one-at-a-time under the VoteSet lock (reference
+    # vote_set.go:203); they accumulate for vote_batch_window_ms (or
+    # until vote_batch_max) and verify as ONE device batch in a worker
+    # thread, then commit under the state mutex with verify=False.
+    # Duplicate/conflict semantics are preserved because add_vote
+    # re-runs every non-signature check at commit time; the pubkey each
+    # lane was verified against is resolved per (height, index), and a
+    # height's validator mapping never changes, so a vote cannot be
+    # committed against a different key than it was verified with.
+
+    def _enqueue_vote(self, vote: Vote, peer_id: str) -> bool:
+        """True if the vote was queued for batch verification (or is a
+        known gossip duplicate); False -> caller takes the sync path."""
+        pk = self._resolve_vote_pubkey(vote)
+        if pk is None:
+            return False
+        vs = self._target_vote_set(vote)
+        if vs is not None and vs.is_duplicate(vote):
+            return True  # already tallied; don't burn a device lane
+        self._vote_buf.append((vote, peer_id, pk))
+        self._vote_pending.set()
+        return True
+
+    def _target_vote_set(self, vote: Vote):
+        rs = self.rs
+        if vote.height + 1 == rs.height and vote.type == VoteType.PRECOMMIT:
+            return rs.last_commit
+        if vote.height == rs.height and rs.votes is not None:
+            return (rs.votes.prevotes(vote.round)
+                    if vote.type == VoteType.PREVOTE
+                    else rs.votes.precommits(vote.round))
+        return None
+
+    def _resolve_vote_pubkey(self, vote: Vote):
+        """The pubkey this vote must verify against, or None if it is
+        not addressable right now (wrong height, unknown index...) —
+        such votes take the synchronous path, which rejects them
+        cheaply before any signature work."""
+        rs = self.rs
+        if vote.height + 1 == rs.height and vote.type == VoteType.PRECOMMIT:
+            vals = (rs.last_commit.val_set
+                    if rs.last_commit is not None else None)
+        elif vote.height == rs.height:
+            vals = rs.validators
+        else:
+            return None
+        if vals is None:
+            return None
+        val = vals.get_by_index(vote.validator_index)
+        if val is None or val.address != vote.validator_address:
+            return None
+        return val.pub_key
+
+    async def _vote_scheduler(self) -> None:
+        from ..libs.metrics import consensus_metrics
+
+        met = consensus_metrics()
+        loop = asyncio.get_running_loop()
+        while True:
+            await self._vote_pending.wait()
+            t_window = _time.perf_counter()
+            window = self.config.vote_batch_window_ms / 1e3
+            if window > 0 and len(self._vote_buf) < self.config.vote_batch_max:
+                await asyncio.sleep(window)
+            batch, self._vote_buf = self._vote_buf, []
+            self._vote_pending.clear()
+            if not batch:
+                continue
+            met.vote_batch_size.observe(len(batch))
+            met.vote_batch_wait_seconds.observe(
+                _time.perf_counter() - t_window)
+            chain_id = self.state.chain_id
+            from ..crypto.batch import BatchVerifier
+
+            bv = BatchVerifier()
+            for vote, _, pk in batch:
+                bv.add(pk, vote.sign_bytes(chain_id), vote.signature)
+            if len(batch) > 1:
+                # Device (or host-oracle) verify OFF the event loop:
+                # gossip, RPC and timeouts keep running during a
+                # 10k-lane commit verify.
+                _, verdicts = await loop.run_in_executor(None, bv.verify)
+            else:
+                _, verdicts = bv.verify()
+            for (vote, peer_id, _), ok in zip(batch, verdicts):
+                if not ok:
+                    self.logger.debug(
+                        "batch-verify rejected vote from %r (val %s)",
+                        peer_id, vote.validator_address.hex(),
+                    )
+                    continue
+                async with self._state_mtx:
+                    await self._try_add_vote(vote, peer_id, preverified=True)
+
+    async def _try_add_vote(self, vote: Vote, peer_id: str,
+                            preverified: bool = False) -> bool:
         """reference tryAddVote (state.go:1845): conflicting votes
         become evidence; late precommits for the last height extend
         rs.last_commit."""
         try:
-            return await self._add_vote(vote, peer_id)
+            return await self._add_vote(vote, peer_id, preverified)
         except ConflictingVoteError as e:
             if self.priv_validator_address == vote.validator_address:
                 self.logger.error(
@@ -694,20 +854,22 @@ class ConsensusState(Service):
             self.logger.debug("vote rejected: %s", e)
             return False
 
-    async def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+    async def _add_vote(self, vote: Vote, peer_id: str,
+                        preverified: bool = False) -> bool:
         rs = self.rs
+        verify = not preverified
         # late precommit for the previous height (state.go:1901)
         if vote.height + 1 == rs.height and vote.type == VoteType.PRECOMMIT:
             if rs.step != RoundStep.NEW_HEIGHT or rs.last_commit is None:
                 return False
-            added = rs.last_commit.add_vote(vote)
+            added = rs.last_commit.add_vote(vote, verify=verify)
             if added:
                 self._publish_vote(vote)
             return added
         if vote.height != rs.height:
             return False
 
-        added = rs.votes.add_vote(vote, peer_id)
+        added = rs.votes.add_vote(vote, peer_id, verify=verify)
         if not added:
             return False
         self._publish_vote(vote)

@@ -61,8 +61,17 @@ def register_pubkey(type_name: str, cls: type) -> None:
 
 
 def pubkey_from_type_and_bytes(type_name: str, data: bytes) -> PubKey:
+    if type_name not in _PUBKEY_REGISTRY:
+        _ensure_registered()
     try:
         cls = _PUBKEY_REGISTRY[type_name]
     except KeyError:
         raise ValueError(f"unknown pubkey type {type_name!r}") from None
     return cls(data)
+
+
+def _ensure_registered() -> None:
+    """Import every key-type module so its register_pubkey ran
+    (reference key-type set: ed25519, sr25519, secp256k1 —
+    crypto/crypto.go + crypto/*/)."""
+    from . import ed25519, secp256k1, sr25519  # noqa: F401

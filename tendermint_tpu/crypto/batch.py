@@ -46,21 +46,31 @@ class BatchVerifier:
 
     def verify(self) -> tuple[bool, np.ndarray]:
         """Returns (all_valid, per-lane verdicts in add order)."""
+        from ..libs.metrics import crypto_metrics
+
+        m = crypto_metrics()
         n = len(self._items)
         if n == 0:
             return True, np.zeros(0, bool)
         verdicts = np.zeros(n, bool)
-        # Group lanes by key type; each group goes through its backend.
-        by_type: dict[str, list[int]] = {}
-        for i, (pk, _, _) in enumerate(self._items):
-            by_type.setdefault(pk.type_name, []).append(i)
-        for type_name, idxs in by_type.items():
-            items = [self._items[i] for i in idxs]
-            group = self._verify_group(type_name, items)
-            verdicts[np.asarray(idxs)] = group
+        with m.batch_seconds.time():
+            # Group lanes by key type; each goes through its backend.
+            by_type: dict[str, list[int]] = {}
+            for i, (pk, _, _) in enumerate(self._items):
+                by_type.setdefault(pk.type_name, []).append(i)
+            for type_name, idxs in by_type.items():
+                items = [self._items[i] for i in idxs]
+                group = self._verify_group(type_name, items)
+                verdicts[np.asarray(idxs)] = group
+        bad = int(n - verdicts.sum())
+        if bad:
+            m.invalid_sigs.inc(bad)
         return bool(verdicts.all()), verdicts
 
     def _verify_group(self, type_name, items) -> np.ndarray:
+        from ..libs.metrics import crypto_metrics
+
+        met = crypto_metrics()
         if type_name == "ed25519":
             use_dev = self._use_device
             if use_dev is None:
@@ -68,21 +78,25 @@ class BatchVerifier:
             if use_dev:
                 from .tpu import verify as tpu_verify
 
+                met.batch_lanes.inc(len(items), backend="tpu")
+                met.device_launches.inc()
                 return tpu_verify.verify_batch(
                     [pk.bytes() for pk, _, _ in items],
                     [m for _, m, _ in items],
                     [s for _, _, s in items],
                 )
-            from . import ed25519_ref
-
+            met.batch_lanes.inc(len(items), backend="host")
+            # Host path: the per-key OpenSSL fast path (strict-accept ->
+            # accept; reject -> ZIP-215 oracle recheck, crypto/ed25519.py).
             return np.fromiter(
                 (
-                    len(s) == 64 and ed25519_ref.verify(pk.bytes(), m, s)
+                    len(s) == 64 and pk.verify_signature(m, s)
                     for pk, m, s in items
                 ),
                 bool,
                 count=len(items),
             )
+        met.batch_lanes.inc(len(items), backend=f"host-{type_name}")
         # Other key types (sr25519, secp256k1): host-side one-by-one via
         # the PubKey objects we already hold.
         return np.fromiter(

@@ -1,11 +1,22 @@
 """Ed25519 keys (reference: crypto/ed25519/ed25519.go).
 
 Signing uses OpenSSL via ``cryptography`` when available (RFC 8032 —
-identical output to the pure-Python path). Verification is ZIP-215 via
-:mod:`tendermint_tpu.crypto.ed25519_ref` — the consensus-normative
-accept set; the TPU batch kernel matches it bit-for-bit. OpenSSL's
-strict RFC 8032 verify is deliberately NOT used for consensus paths (it
-rejects non-canonical encodings ZIP-215 accepts).
+identical output to the pure-Python path).
+
+Verification is ZIP-215 (the consensus-normative accept set; the TPU
+batch kernel matches it bit-for-bit) with a sound OpenSSL fast path:
+OpenSSL's strict RFC 8032 cofactorless verify accepts a strict SUBSET
+of ZIP-215's cofactored accept set — canonical encodings only, and
+[S]B = R + [k]A implies [8]([S]B - R - [k]A) = 0 — so
+
+    OpenSSL accepts  -> accept (≈50 µs, no false accepts possible)
+    OpenSSL rejects  -> recheck with the pure-Python ZIP-215 oracle
+                        (~3 ms, but only for actually-invalid sigs or
+                        the rare non-canonical/small-order edge cases)
+
+This keeps every one-off verify (proposal signatures, privval
+sanity checks, sub-threshold batches) fast without changing the accept
+set by a single bit.
 """
 
 from __future__ import annotations
@@ -20,8 +31,12 @@ PUBKEY_SIZE = 32
 PRIVKEY_SIZE = 64  # seed || pubkey, matching the reference's layout
 SIGNATURE_SIZE = 64
 
-try:  # fast signing path
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+try:  # fast signing + fast-path verification via OpenSSL
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
 
     _HAVE_OPENSSL = True
 except Exception:  # pragma: no cover
@@ -29,13 +44,19 @@ except Exception:  # pragma: no cover
 
 
 class Ed25519PubKey(PubKey):
-    __slots__ = ("_b", "_addr")
+    __slots__ = ("_b", "_addr", "_ossl")
 
     def __init__(self, b: bytes):
         if len(b) != PUBKEY_SIZE:
             raise ValueError(f"ed25519 pubkey must be {PUBKEY_SIZE} bytes")
         self._b = bytes(b)
         self._addr: bytes | None = None
+        self._ossl = None
+        if _HAVE_OPENSSL:
+            try:
+                self._ossl = Ed25519PublicKey.from_public_bytes(self._b)
+            except Exception:
+                self._ossl = None  # non-canonical key: oracle-only path
 
     def address(self) -> bytes:
         if self._addr is None:
@@ -48,6 +69,14 @@ class Ed25519PubKey(PubKey):
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
         if len(sig) != SIGNATURE_SIZE:
             return False
+        if self._ossl is not None:
+            try:
+                self._ossl.verify(sig, msg)
+                return True  # strict accept is a subset of ZIP-215 accept
+            except InvalidSignature:
+                pass  # fall through: ZIP-215 may still accept
+            except Exception:
+                pass
         return ed25519_ref.verify(self._b, msg, sig)
 
     @property

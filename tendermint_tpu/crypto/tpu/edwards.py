@@ -85,10 +85,17 @@ def is_identity(p: Point) -> jnp.ndarray:
 _consts: dict = {}
 
 
-def _d2(n: int) -> jnp.ndarray:
+def _d2(n: int):
+    """Cached NUMPY constant (caching jnp arrays created during a jit
+    trace leaks tracers across traces; numpy folds safely into each)."""
     key = ("d2", n)
     if key not in _consts:
-        _consts[key] = fe.splat(fe.D2, n)
+        import numpy as np
+
+        limbs = np.asarray(fe.to_limbs(fe.D2))[:, None]
+        _consts[key] = np.ascontiguousarray(
+            np.broadcast_to(limbs, (22, n))
+        )
     return _consts[key]
 
 

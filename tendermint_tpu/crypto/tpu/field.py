@@ -96,11 +96,20 @@ def _fold_top(r: jnp.ndarray, ctop: jnp.ndarray) -> jnp.ndarray:
     Split across limbs 0 and 1 so the added values stay small:
     19*c * 2^9 = ((19c) & 7) * 2^9  +  ((19c) >> 3) * 2^12.
     Safe for ctop up to ~5e7.
+
+    Written as a concatenate (not scatter/dynamic-update) so XLA fuses
+    it into the surrounding elementwise graph instead of serializing
+    buffer updates.
     """
     t = ctop * 19
-    r = r.at[0].add((t & 7) << 9)
-    r = r.at[1].add(t >> 3)
-    return r
+    return jnp.concatenate(
+        [
+            (r[0] + ((t & 7) << 9))[None],
+            (r[1] + (t >> 3))[None],
+            r[2:],
+        ],
+        axis=0,
+    )
 
 
 def _pass22(x: jnp.ndarray) -> jnp.ndarray:
@@ -110,7 +119,7 @@ def _pass22(x: jnp.ndarray) -> jnp.ndarray:
     """
     c = x >> BITS
     r = x & MASK
-    r = r.at[1:].add(c[:-1])
+    r = jnp.concatenate([r[:1], r[1:] + c[:-1]], axis=0)
     return _fold_top(r, c[-1])
 
 
@@ -141,11 +150,25 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """
     a = jnp.asarray(a)
     b = jnp.asarray(b)
-    n = a.shape[-1]
-    c = jnp.zeros((2 * NLIMB - 1, n), jnp.int32)
-    for i in range(NLIMB):
-        c = c.at[i : i + NLIMB].add(a[i] * b)
-    return _reduce43(c)
+    # 22 row-broadcast multiplies (each (22, N) wide — vectorized over
+    # the limb axis), shifted into the 43 columns by zero-padding, and
+    # summed as a log-depth tree. No dynamic-update-slice chains: the
+    # whole product graph is data-parallel adds XLA fuses freely.
+    terms = [
+        jnp.pad(a[i] * b, ((i, NLIMB - 1 - i), (0, 0)))
+        for i in range(NLIMB)
+    ]
+    return _reduce43(_balanced_sum(terms))
+
+
+def _balanced_sum(terms: list) -> jnp.ndarray:
+    """Tree-shaped sum: log-depth adder chain instead of a serial one."""
+    while len(terms) > 1:
+        nxt = [terms[i] + terms[i + 1] for i in range(0, len(terms) - 1, 2)]
+        if len(terms) & 1:
+            nxt.append(terms[-1])
+        terms = nxt
+    return terms[0]
 
 
 def sqr(a: jnp.ndarray) -> jnp.ndarray:
@@ -159,12 +182,18 @@ def sqr(a: jnp.ndarray) -> jnp.ndarray:
     a = jnp.asarray(a)
     n = a.shape[-1]
     a2 = a + a
-    c = jnp.zeros((2 * NLIMB - 1, n), jnp.int32)
-    for i in range(NLIMB):
-        c = c.at[2 * i].add(a[i] * a[i])
-        if i + 1 < NLIMB:
-            c = c.at[2 * i + 1 : i + NLIMB].add(a2[i] * a[i + 1 :])
-    return _reduce43(c)
+    # Diagonal a_i^2 terms land on even columns 0,2,..,42: interleave
+    # with zero rows via a stack+reshape (one multiply, no scatter).
+    diag = a * a  # (22, N)
+    diag43 = jnp.stack([diag, jnp.zeros_like(diag)], axis=1).reshape(
+        2 * NLIMB, n
+    )[: 2 * NLIMB - 1]
+    # Cross terms 2*a_i*a_j (i<j) shifted to column i+j.
+    terms = [diag43]
+    for i in range(NLIMB - 1):
+        prod = a2[i] * a[i + 1 :]  # (21-i, N), columns 2i+1 .. i+21
+        terms.append(jnp.pad(prod, ((2 * i + 1, NLIMB - 1 - i), (0, 0))))
+    return _reduce43(_balanced_sum(terms))
 
 
 def _reduce43(c: jnp.ndarray) -> jnp.ndarray:
@@ -172,19 +201,18 @@ def _reduce43(c: jnp.ndarray) -> jnp.ndarray:
     # Pass 1: carry into 44 limbs; carries <= 1.31e9 >> 12 ≈ 3.2e5.
     cc = c >> BITS
     r = c & MASK
-    r = r.at[1:].add(cc[:-1])
-    r = jnp.concatenate([r, cc[-1:]], axis=0)  # (44, N)
+    r = jnp.concatenate([r[:1], r[1:] + cc[:-1], cc[-1:]], axis=0)  # (44, N)
     # Fold: limb (22+m) has weight 2^264 * 2^(12m) ≡ 19*2^9 * 2^(12m).
     # Split so nothing overflows: 19*hi * 2^9 = ((19h)&7)<<9 at limb m
     # plus (19h)>>3 at limb m+1; the m=21 spill (weight 2^264 again)
     # folds once more — it is small (<= ~1.5e7) by then.
     t = r[NLIMB:] * 19  # <= 19 * 3.3e5 ≈ 6.3e6
-    d = r[:NLIMB]
-    d = d + ((t & 7) << 9)
-    d = d.at[1:].add(t[:-1] >> 3)
     t2 = (t[-1] >> 3) * 19
-    d = d.at[0].add((t2 & 7) << 9)
-    d = d.at[1].add(t2 >> 3)
+    hi_shift = t >> 3  # enters one limb up
+    d0 = r[0] + ((t[0] & 7) << 9) + ((t2 & 7) << 9)
+    d1 = r[1] + ((t[1] & 7) << 9) + hi_shift[0] + (t2 >> 3)
+    rest = r[2:NLIMB] + ((t[2:] & 7) << 9) + hi_shift[1:-1]
+    d = jnp.concatenate([d0[None], d1[None], rest], axis=0)
     # Three parallel passes: ~3e6 -> ~8.6e3 -> REDUCED.
     d = _pass22(d)
     d = _pass22(d)

@@ -1,0 +1,125 @@
+"""Device-side byte unpacking and challenge-scalar folding.
+
+Round-1 packed these on host (a per-signature Python loop costing
+~300 ms at 10k lanes — the single biggest line in the round-1 bench).
+Everything here is static-shape int32 jnp, so the whole path fuses into
+the verify kernel and the host ships raw bytes only.
+
+Key trick — the challenge k = SHA-512(R||A||M) does NOT need canonical
+reduction mod L. The verified equation is cofactored
+([8][S]B == [8]R + [8][k]A, crypto/ed25519_ref.py), and the full group
+order is 8L, so replacing k by any k' ≡ k (mod L) leaves [8][k']A
+unchanged: the [8] kills the small-order component and L divides the
+prime-order part's scalar difference. We therefore fold the 512-bit
+digest once through a (44 x 22) constant table of 2^(12i) mod L —
+one small integer contraction — and run the scalar-mult loop over 69
+4-bit windows (the folded value is < 2^271) instead of 64.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .. import ed25519_ref as ref
+
+NLIMB = 22
+BITS = 12
+MASK = (1 << BITS) - 1
+DIGITS_K = 69  # folded challenge < 2^271 -> 69 nibbles
+KLIMB = 23
+
+
+@functools.cache
+def fold_table_mod_l() -> np.ndarray:
+    """(43, 22) int32: limb decomposition of 2^(12*i) mod L.
+
+    43 limbs cover the 512-bit digest exactly (43*12 = 516); a 44th
+    limb would index past the digest bytes (JAX clamps out-of-range
+    gathers silently -> garbage)."""
+    tab = np.zeros((43, NLIMB), np.int32)
+    for i in range(43):
+        v = pow(2, BITS * i, ref.L)
+        for j in range(NLIMB):
+            tab[i, j] = v & MASK
+            v >>= BITS
+    tab.setflags(write=False)
+    return tab
+
+
+def _jnp():
+    import jax
+    import jax.numpy as jnp
+
+    return jax, jnp
+
+
+def bytes_to_limbs(byte_rows, nlimb: int):
+    """(nbytes, N) int32 byte rows (LE) -> (nlimb, N) 12-bit limbs.
+
+    Each limb spans 1.5 bytes; static shift/mask per limb row.
+    """
+    jax, jnp = _jnp()
+    rows = []
+    for k in range(nlimb):
+        bit = BITS * k
+        j, s = bit // 8, bit % 8  # s in {0, 4}
+        v = byte_rows[j] >> s
+        if j + 1 < byte_rows.shape[0]:
+            v = v | (byte_rows[j + 1] << (8 - s))
+        if s and j + 2 < byte_rows.shape[0]:
+            v = v | (byte_rows[j + 2] << (16 - s))
+        rows.append(v & MASK)
+    return jnp.stack(rows)
+
+
+def fold_digest(digest_rows):
+    """(64, N) int32 digest bytes (LE) -> (DIGITS_K, N) int32 nibbles,
+    MSB-first, of a representative ≡ digest (mod L), < 2^271."""
+    jax, jnp = _jnp()
+    limbs44 = bytes_to_limbs(digest_rows, 43)  # (43, N), each < 4096
+    tab = jnp.asarray(fold_table_mod_l())
+    # Column sums <= 44 * 4095 * 4095 = 7.4e8 < 2^31.
+    acc = jnp.einsum("wn,wl->ln", limbs44, tab)  # (22, N)
+    # Bounds: m_w = 2^(12w) mod L < L ≈ 2^252, so limb 21 of every m_w
+    # is <= 1 and acc[21] <= 43*4095 ≈ 1.8e5; lower limbs <= 7.3e8.
+    # Pass 1 grows to 23 limbs with acc[22] <= 45; subsequent passes
+    # provably carry nothing out of limb 22 (<= 91 < 4096), so width
+    # stays 23 and the value (< 2^271 < 2^276) is exact — no mod-p
+    # wraparound here, this is a plain integer.
+    c = acc >> BITS
+    r = acc & MASK
+    acc = jnp.concatenate([r[:1], r[1:] + c[:-1], c[-1:]], axis=0)  # (23, N)
+    c = acc >> BITS
+    r = acc & MASK
+    acc = jnp.concatenate([r[:1], r[1:] + c[:-1]], axis=0)
+    # Exact final ripple (parallel passes can leave a limb at 4096):
+    # 23 sequential steps over (N,) lanes — trivially cheap, and the
+    # nibble extraction below requires limbs strictly < 4096.
+    def step(carry, limb):
+        v = limb + carry
+        return v >> BITS, v & MASK
+    _, acc = jax.lax.scan(step, jnp.zeros(acc.shape[-1], jnp.int32), acc)
+    nibs = limbs_to_nibbles(acc)  # (69, N) LSB-first
+    return nibs[::-1]
+
+
+def limbs_to_nibbles(limbs):
+    """(K, N) 12-bit limbs -> (3K, N) nibbles, LSB-first."""
+    jax, jnp = _jnp()
+    rows = []
+    for k in range(limbs.shape[0]):
+        for s in (0, 4, 8):
+            rows.append((limbs[k] >> s) & 15)
+    return jnp.stack(rows)
+
+
+def bytes_to_nibbles(byte_rows):
+    """(nbytes, N) int32 bytes (LE) -> (2*nbytes, N) nibbles LSB-first."""
+    jax, jnp = _jnp()
+    rows = []
+    for j in range(byte_rows.shape[0]):
+        rows.append(byte_rows[j] & 15)
+        rows.append(byte_rows[j] >> 4)
+    return jnp.stack(rows)

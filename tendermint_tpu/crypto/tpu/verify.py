@@ -1,14 +1,21 @@
-"""Batched ZIP-215 ed25519 verification kernel + host-side packing.
+"""Batched ZIP-215 ed25519 verification: one fused device program.
 
-The device program checks, per lane, the cofactored equation
-    [8]([S]B - [k]A - R) == identity
-with one fused Straus/comb pass: [k](-A) via 4-bit windows MSB-first
-(4 doublings + 1 table add per window, per-lane table [0..15]*(-A)),
-and [S]B via a fixed-base comb (64 precomputed 16-entry tables of
-j * 16^w * B — no doublings), both inside one lax.fori_loop. SHA-512
-and scalar reduction mod L happen host-side (variable-length messages
-don't belong on the MXU); everything group-theoretic runs on device in
-exact int32 limb arithmetic.
+The device program takes RAW BYTES (pubkeys, signatures, SHA-padded
+messages) and produces per-lane verdicts; everything in between —
+SHA-512 of R||A||M (sha512.py), challenge folding mod L (scalar.py),
+byte->limb unpacking, ZIP-215 decompression, and the fused
+Straus-window + fixed-base-comb scalar multiplication — runs on device
+in one XLA program. Host work is four numpy concatenations and the
+S < L range check; round 1's per-signature Python packing loop
+(~300 ms at 10k lanes on this single-core host) is gone.
+
+Per lane the kernel checks the cofactored equation
+    [8]([S]B - [k](A) - R) == identity
+with k folded to a 271-bit representative (see scalar.fold_digest for
+why no canonical mod-L reduction is needed): [k](-A) via 4-bit windows
+MSB-first over 69 windows (4 doublings + 1 per-lane table add each),
+[S]B via a fixed-base comb (shared 16-entry tables of j * 16^w * B),
+both inside one lax.fori_loop.
 
 Semantics match crypto/ed25519_ref.py bit-for-bit (golden-tested):
 reference hot-path parity per SURVEY §2.2 — the call sites it serves
@@ -29,17 +36,28 @@ from .. import ed25519_ref as ref
 _L = ref.L
 _MAX_BATCH = 1 << 15
 _MIN_BATCH = 1 << 7
+# Shard over the device mesh only from this bucket size up: tiny
+# batches aren't worth the per-device dispatch, and it keeps small-shape
+# compiles single-device.
+_SHARD_MIN = 1 << 11
+_DIGITS_K = 69  # scalar.DIGITS_K; windows in the fused loop
+
+# L as four little-endian uint64 words, for the vectorized S < L check.
+_L_WORDS = np.frombuffer(_L.to_bytes(32, "little"), np.uint64)
+
 
 @functools.cache
 def b_comb_tables() -> np.ndarray:
-    """(64, 16, 3, 22) int32: affine (x, y, x*y) of j * 16^w * B.
+    """(69, 16, 3, 22) int32: affine (x, y, x*y) of j * 16^w * B.
 
-    Entry (w, 0) is the identity (0, 1, 0). Built once host-side with
-    the pure-Python oracle arithmetic (~1.2k point ops).
+    Entry (w, 0) is the identity (0, 1, 0). Windows 64..68 exist only
+    to keep the fused 69-iteration loop uniform — S has 64 nibbles, the
+    padded digit rows select entry 0, so those windows are all-identity.
+    Built once host-side with the pure-Python oracle (~1.2k point ops).
     """
     from . import field as fe
 
-    tab = np.zeros((64, 16, 3, 22), np.int32)
+    tab = np.zeros((_DIGITS_K, 16, 3, 22), np.int32)
     base = ref._B_PT
     for w in range(64):
         acc = ref.IDENTITY
@@ -54,12 +72,18 @@ def b_comb_tables() -> np.ndarray:
             tab[w, j, 2] = fe.to_limbs((x * y) % ref.P)
         for _ in range(4):
             base = ref.pt_double(base)
+    for w in range(64, _DIGITS_K):
+        tab[w, :, 1, 0] = 1  # identity (0, 1, 0) in every entry
     tab.setflags(write=False)
     return tab
 
 
 def _bytes32_to_limbs(arr: np.ndarray) -> np.ndarray:
-    """(N, 32) uint8 (top bit already cleared) -> (22, N) int32 limbs."""
+    """(N, 32) uint8 (top bit already cleared) -> (22, N) int32 limbs.
+
+    Host-side helper (tests and table precomputation); the hot path
+    unpacks on device via scalar.bytes_to_limbs.
+    """
     bits = np.unpackbits(arr, axis=1, bitorder="little")  # (N, 256)
     bits = np.pad(bits, ((0, 0), (0, 264 - 256)))
     bits = bits.reshape(arr.shape[0], 22, 12)
@@ -68,49 +92,39 @@ def _bytes32_to_limbs(arr: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(limbs.T)
 
 
-def _nibbles(arr: np.ndarray) -> np.ndarray:
-    """(N, 32) uint8 scalar bytes (LE) -> (64, N) int32 nibbles LSB-first."""
-    lo = arr & 15
-    hi = arr >> 4
-    out = np.empty((arr.shape[0], 64), np.int32)
-    out[:, 0::2] = lo
-    out[:, 1::2] = hi
-    return np.ascontiguousarray(out.T)
-
-
 def pack_batch(pubs, msgs, sigs) -> dict[str, np.ndarray]:
-    """Host-side preparation of a batch for the device kernel."""
+    """Host-side preparation: raw byte arrays + SHA padding + S < L.
+
+    All numpy-vectorized; no per-signature Python.
+    """
+    from . import sha512 as sh
+
     n = len(pubs)
     a_raw = np.frombuffer(b"".join(pubs), np.uint8).reshape(n, 32)
     sig_raw = np.frombuffer(b"".join(sigs), np.uint8).reshape(n, 64)
-    r_raw = sig_raw[:, :32]
-    s_raw = sig_raw[:, 32:]
+    msg_pad, nblocks = sh.pad_messages(list(msgs), prefix_len=64)
+    # Bucket the padded width to power-of-two block counts so kernel
+    # shapes (and recompiles) stay bounded; extra blocks are zeros and
+    # every lane past its own nblocks is frozen in compress_blocks.
+    total_blocks = (msg_pad.shape[1] + 64) // 128
+    tb = 1
+    while tb < total_blocks:
+        tb <<= 1
+    if tb != total_blocks:
+        msg_pad = np.pad(msg_pad, ((0, 0), (0, (tb - total_blocks) * 128)))
 
-    a_sign = (a_raw[:, 31] >> 7).astype(np.int32)
-    r_sign = (r_raw[:, 31] >> 7).astype(np.int32)
-    a_y = a_raw.copy()
-    a_y[:, 31] &= 0x7F
-    r_y = r_raw.copy()
-    r_y[:, 31] &= 0x7F
-
-    k_bytes = np.empty((n, 32), np.uint8)
-    s_ok = np.empty(n, bool)
-    for i in range(n):
-        rb, ab = bytes(sig_raw[i, :32]), bytes(a_raw[i])
-        k = int.from_bytes(hashlib.sha512(rb + ab + msgs[i]).digest(), "little") % _L
-        k_bytes[i] = np.frombuffer(k.to_bytes(32, "little"), np.uint8)
-        s_ok[i] = int.from_bytes(bytes(s_raw[i]), "little") < _L
-
-    digk = _nibbles(k_bytes)[::-1].copy()  # MSB-first for the doubling loop
-    digs = _nibbles(np.ascontiguousarray(s_raw))  # LSB-first, matches comb tables
+    s_words = sig_raw[:, 32:].copy().view(np.uint64)  # (n, 4) LE words
+    lt = np.zeros(n, bool)
+    gt = np.zeros(n, bool)
+    for w in (3, 2, 1, 0):
+        lt |= ~gt & ~lt & (s_words[:, w] < _L_WORDS[w])
+        gt |= ~gt & ~lt & (s_words[:, w] > _L_WORDS[w])
     return dict(
-        a_y=_bytes32_to_limbs(a_y),
-        a_sign=a_sign,
-        r_y=_bytes32_to_limbs(r_y),
-        r_sign=r_sign,
-        digk=digk,
-        digs=digs,
-        s_ok=s_ok,
+        ab=a_raw,
+        sb=sig_raw,
+        msg=msg_pad,
+        nblocks=nblocks,
+        s_ok=lt,
     )
 
 
@@ -122,12 +136,39 @@ def _kernel():
 
     from . import edwards as ed
     from . import field as fe
+    from . import scalar as sc
+    from . import sha512 as sh
 
     @jax.jit
-    def kernel(a_y, a_sign, r_y, r_sign, digk, digs, s_ok, btab):
-        n = a_y.shape[-1]
-        A, a_ok = ed.decompress(a_y, a_sign)
-        R, r_ok = ed.decompress(r_y, r_sign)
+    def kernel(ab, sb, msg, nblocks, s_ok, btab):
+        n = ab.shape[0]
+        # --- SHA-512 of R || A || M, all lanes at once.
+        full = jnp.concatenate([sb[:, :32], ab, msg], axis=1)
+        digest = sh.compress_blocks(sh.bytes_to_words(full), nblocks)
+        digk = sc.fold_digest(sh.digest_bytes_le(digest))  # (69, N) MSB-first
+        # --- byte rows.
+        a_bytes = ab.astype(jnp.int32).T  # (32, N)
+        sig_bytes = sb.astype(jnp.int32).T  # (64, N)
+        digs = sc.bytes_to_nibbles(sig_bytes[32:])  # (64, N) LSB-first
+        digs = jnp.concatenate(
+            [digs, jnp.zeros((_DIGITS_K - 64, n), jnp.int32)], axis=0
+        )
+        a_sign = a_bytes[31] >> 7
+        r_sign = sig_bytes[31] >> 7
+        a_top = (a_bytes[31] & 0x7F)[None]
+        r_top = (sig_bytes[31] & 0x7F)[None]
+        a_y = sc.bytes_to_limbs(jnp.concatenate([a_bytes[:31], a_top]), 22)
+        r_y = sc.bytes_to_limbs(jnp.concatenate([sig_bytes[:31], r_top]), 22)
+
+        # --- decompress A and R fused at width 2N (halves the number of
+        # expensive sqrt-exponentiation op dispatches).
+        y2 = jnp.concatenate([a_y, r_y], axis=1)
+        s2 = jnp.concatenate([a_sign, r_sign])
+        p2, ok2 = ed.decompress(y2, s2)
+        A = ed.Point(p2.x[:, :n], p2.y[:, :n], p2.z[:, :n], p2.t[:, :n])
+        R = ed.Point(p2.x[:, n:], p2.y[:, n:], p2.z[:, n:], p2.t[:, n:])
+        a_ok, r_ok = ok2[:n], ok2[n:]
+
         neg_a = ed.neg(A)
         tbl = ed.build_window_table(neg_a, 16)  # (16, 4, 22, N)
         neg_r = ed.neg(R)
@@ -144,7 +185,7 @@ def _kernel():
             return (acc_a, acc_b)
 
         acc_a, acc_b = jax.lax.fori_loop(
-            0, 64, body, (ed.identity(n), ed.identity(n))
+            0, _DIGITS_K, body, (ed.identity(n), ed.identity(n))
         )
         v = ed.add(acc_a, acc_b)
         v = ed.add(v, neg_r)
@@ -152,6 +193,40 @@ def _kernel():
         return ed.is_identity(v) & a_ok & r_ok & jnp.asarray(s_ok)
 
     return kernel
+
+
+@functools.cache
+def _mesh():
+    """A ('dp',) mesh over all local devices, or None single-device.
+
+    The verify workload is pure data-parallel over signature lanes
+    (SURVEY §2.10: DP = lanes; the cross-chip axis shards a mega-commit
+    over ICI). Every op in the kernel is elementwise over the lane axis
+    or a contraction over limb/window axes, so XLA compiles the sharded
+    program with zero collectives; the only cross-chip traffic is the
+    verdict gather at the end.
+    """
+    import jax
+
+    devs = jax.devices()
+    if len(devs) <= 1:
+        return None
+    import numpy as np_
+
+    from jax.sharding import Mesh
+
+    return Mesh(np_.array(devs), ("dp",))
+
+
+def _shardings(mesh):
+    """(lane-sharded 2d rows, lane-sharded 1d, replicated) NamedShardings."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return (
+        NamedSharding(mesh, P("dp")),      # (N, ...) arrays: shard axis 0
+        NamedSharding(mesh, P("dp")),      # (N,) vectors
+        NamedSharding(mesh, P()),          # replicated consts
+    )
 
 
 @functools.cache
@@ -209,16 +284,23 @@ def verify_batch(pubs, msgs, sigs) -> np.ndarray:
 
     out = np.empty(n, bool)
     start = 0
+    pending = []
     for size in _chunks(n):
         end = min(start + size, n)
-        out[start:end] = _verify_chunk(
-            pubs[start:end], msgs[start:end], sigs[start:end], size
+        pending.append(
+            (start, end, _launch_chunk(pubs[start:end], msgs[start:end],
+                                       sigs[start:end], size))
         )
         start = end
+    for s, e, fut in pending:
+        out[s:e] = np.asarray(fut)[: e - s]
     return out & well_formed
 
 
-def _verify_chunk(pubs, msgs, sigs, bucket: int) -> np.ndarray:
+def _launch_chunk(pubs, msgs, sigs, bucket: int):
+    """Dispatch one bucket-sized kernel launch; returns the device array
+    (async — caller materializes). Padding lanes use a fixed valid
+    triple so they cannot affect real lanes."""
     n = len(pubs)
     if bucket > n:
         dp, dm, ds = _dummy_triple()
@@ -227,5 +309,16 @@ def _verify_chunk(pubs, msgs, sigs, bucket: int) -> np.ndarray:
         msgs = list(msgs) + [dm] * pad
         sigs = list(sigs) + [ds] * pad
     packed = pack_batch(pubs, msgs, sigs)
-    verdict = _kernel()(btab=b_comb_tables(), **packed)
-    return np.asarray(verdict)[:n]
+    btab = b_comb_tables()
+    mesh = _mesh()
+    if (mesh is not None and bucket >= _SHARD_MIN
+            and bucket % mesh.devices.size == 0):
+        import jax
+
+        row_s, vec_s, repl_s = _shardings(mesh)
+        packed = {
+            k: jax.device_put(v, vec_s if v.ndim == 1 else row_s)
+            for k, v in packed.items()
+        }
+        btab = jax.device_put(btab, repl_s)
+    return _kernel()(btab=btab, **packed)

@@ -1,0 +1,145 @@
+"""Debug/profiling HTTP server — pprof analogue + Prometheus listener.
+
+Reference: node/node.go:807-812 serves net/http/pprof on
+`rpc.pprof-laddr`, and a Prometheus listener on
+`instrumentation.prometheus_listen_addr`. The Python equivalents:
+
+  GET /debug/pprof/            index
+  GET /debug/pprof/goroutine   all asyncio tasks + thread stacks
+                               (the goroutine-dump analogue)
+  GET /debug/pprof/heap        tracemalloc top allocations (starts
+                               tracemalloc on first call)
+  GET /debug/pprof/profile?seconds=N
+                               cProfile the event loop process for N
+                               seconds, return pstats text
+  GET /metrics                 Prometheus text exposition
+
+Used by `tendermint-tpu debug kill|dump` (cmd/) to capture diagnostics
+bundles, mirroring cmd/tendermint/commands/debug/{kill,dump}.go.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import logging
+import sys
+import traceback
+
+logger = logging.getLogger("debugsrv")
+
+
+def _goroutine_dump() -> str:
+    out = io.StringIO()
+    tasks = asyncio.all_tasks()
+    out.write(f"asyncio tasks: {len(tasks)}\n\n")
+    for t in sorted(tasks, key=lambda t: t.get_name()):
+        out.write(f"--- task {t.get_name()} "
+                  f"({'done' if t.done() else 'pending'})\n")
+        for line in t.get_stack(limit=20):
+            out.write("".join(traceback.format_stack(line, limit=20)[-1]))
+        out.write("\n")
+    out.write(f"\nthreads: {len(sys._current_frames())}\n\n")
+    import threading
+
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for tid, frame in sys._current_frames().items():
+        out.write(f"--- thread {names.get(tid, tid)}\n")
+        out.write("".join(traceback.format_stack(frame)))
+        out.write("\n")
+    return out.getvalue()
+
+
+def _heap_dump() -> str:
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start()
+        return ("tracemalloc just started; call again after some "
+                "allocations for a meaningful snapshot\n")
+    snap = tracemalloc.take_snapshot()
+    out = io.StringIO()
+    current, peak = tracemalloc.get_traced_memory()
+    out.write(f"traced current={current} peak={peak}\n\n")
+    for stat in snap.statistics("lineno")[:50]:
+        out.write(f"{stat}\n")
+    return out.getvalue()
+
+
+async def _profile(seconds: float) -> str:
+    import cProfile
+    import pstats
+
+    prof = cProfile.Profile()
+    prof.enable()
+    await asyncio.sleep(min(seconds, 60.0))
+    prof.disable()
+    out = io.StringIO()
+    pstats.Stats(prof, stream=out).sort_stats("cumulative").print_stats(60)
+    return out.getvalue()
+
+
+class DebugServer:
+    """Tiny HTTP/1.0 server for the routes above."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._server = None
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._serve, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("debug/pprof server on %s:%d", self.host, self.port)
+        return self.port
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+
+    async def _serve(self, reader, writer) -> None:
+        try:
+            line = await reader.readline()
+            parts = line.decode().split(" ")
+            if len(parts) < 2:
+                return
+            target = parts[1]
+            while (await reader.readline()) not in (b"\r\n", b"\n", b""):
+                pass
+            path, _, query = target.partition("?")
+            params = dict(
+                kv.partition("=")[::2] for kv in query.split("&") if kv
+            )
+            body = await self._route(path, params)
+            writer.write(
+                b"HTTP/1.0 200 OK\r\nContent-Type: text/plain\r\n"
+                b"Content-Length: " + str(len(body)).encode() +
+                b"\r\n\r\n" + body
+            )
+            await writer.drain()
+        except Exception:
+            logger.exception("debug request failed")
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _route(self, path: str, params: dict) -> bytes:
+        if path in ("/debug/pprof", "/debug/pprof/"):
+            return (b"pprof endpoints: goroutine, heap, profile?seconds=N; "
+                    b"also /metrics\n")
+        if path == "/debug/pprof/goroutine":
+            return _goroutine_dump().encode()
+        if path == "/debug/pprof/heap":
+            return _heap_dump().encode()
+        if path == "/debug/pprof/profile":
+            secs = float(params.get("seconds", "5"))
+            return (await _profile(secs)).encode()
+        if path == "/metrics":
+            from .metrics import DEFAULT
+
+            return DEFAULT.render_text().encode()
+        return b"unknown path; see /debug/pprof/\n"

@@ -1,0 +1,307 @@
+"""Prometheus-style metrics (reference: libs + per-module metrics.go,
+docs/nodes/metrics.md:21-52).
+
+Counters, gauges and histograms with optional labels, collected in a
+process-global registry and rendered in the Prometheus text exposition
+format. Served on the RPC listener at GET /metrics and (when
+`instrumentation.prometheus` is on) on a dedicated listener, mirroring
+the reference's MetricsProvider wiring (node/node.go:110-125).
+
+Implementation is deliberately tiny and allocation-light: consensus
+hot paths (vote batches, device launches) record into plain floats
+under no lock — the event-loop/worker structure makes races harmless
+for monitoring data, same stance as Prometheus client libs' relaxed
+atomicity on Python.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+def _fmt_labels(labels: dict[str, str] | None) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{v}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class Metric:
+    def __init__(self, name: str, help_: str, namespace: str = ""):
+        self.name = f"{namespace}_{name}" if namespace else name
+        self.help = help_
+
+    def render(self) -> list[str]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help_: str, namespace: str = ""):
+        super().__init__(name, help_, namespace)
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} {self.kind}"]
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(dict(key))} {_fmt_value(v)}")
+        if not self._values:
+            out.append(f"{self.name} 0")
+        return out
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        self._values[tuple(sorted(labels.items()))] = float(value)
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+
+_DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_: str, namespace: str = "",
+                 buckets: tuple = _DEFAULT_BUCKETS):
+        super().__init__(name, help_, namespace)
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._n = 0
+
+    def observe(self, value: float) -> None:
+        self._sum += value
+        self._n += 1
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                self._counts[i] += 1
+                return
+        self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        cum = 0
+        for i, b in enumerate(self.buckets):
+            cum += self._counts[i]
+            out.append(f'{self.name}_bucket{{le="{_fmt_value(b)}"}} {cum}')
+        cum += self._counts[-1]
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+        out.append(f"{self.name}_sum {_fmt_value(self._sum)}")
+        out.append(f"{self.name}_count {self._n}")
+        return out
+
+    class _Timer:
+        def __init__(self, h: "Histogram"):
+            self._h = h
+
+        def __enter__(self):
+            self._t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self._h.observe(time.perf_counter() - self._t0)
+            return False
+
+    def time(self) -> "_Timer":
+        return self._Timer(self)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list[Metric] = []
+        self._lock = threading.Lock()
+
+    def register(self, m: Metric) -> Metric:
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def counter(self, name, help_, namespace="") -> Counter:
+        return self.register(Counter(name, help_, namespace))
+
+    def gauge(self, name, help_, namespace="") -> Gauge:
+        return self.register(Gauge(name, help_, namespace))
+
+    def histogram(self, name, help_, namespace="",
+                  buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self.register(Histogram(name, help_, namespace, buckets))
+
+    def render_text(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics)
+        lines = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+# Process-global registry — the MetricsProvider analogue.
+DEFAULT = Registry()
+
+
+@dataclass
+class ConsensusMetrics:
+    """reference: consensus/metrics.go."""
+    height: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "height", "Height of the chain.", "consensus"))
+    rounds: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "rounds", "Round of the chain.", "consensus"))
+    validators: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "validators", "Number of validators.", "consensus"))
+    validators_power: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "validators_power", "Total voting power of validators.", "consensus"))
+    missing_validators: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "missing_validators", "Validators absent from the last commit.",
+        "consensus"))
+    byzantine_validators: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "byzantine_validators", "Validators that equivocated.", "consensus"))
+    num_txs: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "num_txs", "Transactions in the latest block.", "consensus"))
+    block_size_bytes: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "block_size_bytes", "Size of the latest block.", "consensus"))
+    total_txs: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "total_txs", "Total transactions committed.", "consensus"))
+    block_interval_seconds: Histogram = field(
+        default_factory=lambda: DEFAULT.histogram(
+            "block_interval_seconds", "Time between blocks.", "consensus",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60)))
+    fast_sync_blocks: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "fast_sync_blocks", "Blocks applied via fast sync.", "consensus"))
+    # --- TPU batch-verify observability (new capability; no reference
+    # equivalent): these are the numbers that justify _DEVICE_THRESHOLD
+    # and the micro-batch window empirically.
+    vote_batch_size: Histogram = field(
+        default_factory=lambda: DEFAULT.histogram(
+            "vote_batch_size", "Votes per micro-batch.", "consensus",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)))
+    vote_batch_wait_seconds: Histogram = field(
+        default_factory=lambda: DEFAULT.histogram(
+            "vote_batch_wait_seconds",
+            "Window wait before a vote micro-batch verified.", "consensus"))
+
+
+@dataclass
+class CryptoMetrics:
+    """Batch-verifier instrumentation (new; the SURVEY §6 speedup
+    denominators come straight from these)."""
+    batch_lanes: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "batch_lanes_total", "Signature lanes verified, by backend.",
+        "crypto"))
+    batch_seconds: Histogram = field(
+        default_factory=lambda: DEFAULT.histogram(
+            "batch_verify_seconds", "Wall time per verify() call.",
+            "crypto"))
+    device_launches: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "device_launches_total", "Device kernel launches.", "crypto"))
+    invalid_sigs: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "invalid_signatures_total", "Lanes that failed verification.",
+        "crypto"))
+
+
+@dataclass
+class P2PMetrics:
+    """reference: p2p/metrics.go."""
+    peers: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "peers", "Connected peers.", "p2p"))
+    peer_receive_bytes: Counter = field(
+        default_factory=lambda: DEFAULT.counter(
+            "peer_receive_bytes_total", "Bytes received, by channel.",
+            "p2p"))
+    peer_send_bytes: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "peer_send_bytes_total", "Bytes sent, by channel.", "p2p"))
+    pending_send_bytes: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "pending_send_bytes", "Pending bytes across peers.", "p2p"))
+
+
+@dataclass
+class MempoolMetrics:
+    """reference: mempool/metrics.go."""
+    size: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "size", "Transactions in the mempool.", "mempool"))
+    tx_size_bytes: Histogram = field(default_factory=lambda: DEFAULT.histogram(
+        "tx_size_bytes", "Transaction sizes.", "mempool",
+        buckets=(32, 128, 512, 2048, 8192, 32768, 131072)))
+    failed_txs: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "failed_txs", "CheckTx rejections.", "mempool"))
+    recheck_times: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "recheck_times", "Transactions rechecked after commit.", "mempool"))
+
+
+@dataclass
+class StateMetrics:
+    """reference: state/metrics.go."""
+    block_processing_seconds: Histogram = field(
+        default_factory=lambda: DEFAULT.histogram(
+            "block_processing_seconds", "ApplyBlock wall time.", "state"))
+    commit_verify_seconds: Histogram = field(
+        default_factory=lambda: DEFAULT.histogram(
+            "commit_verify_seconds",
+            "LastCommit signature-batch wall time.", "state"))
+
+
+_SINGLETONS: dict[str, object] = {}
+
+
+def _singleton(key: str, cls):
+    # NOT setdefault(key, cls()): constructing the dataclass registers
+    # its metrics into DEFAULT, so the constructor must only ever run
+    # once per key.
+    if key not in _SINGLETONS:
+        _SINGLETONS[key] = cls()
+    return _SINGLETONS[key]
+
+
+def consensus_metrics() -> ConsensusMetrics:
+    return _singleton("consensus", ConsensusMetrics)
+
+
+def crypto_metrics() -> CryptoMetrics:
+    return _singleton("crypto", CryptoMetrics)
+
+
+def p2p_metrics() -> P2PMetrics:
+    return _singleton("p2p", P2PMetrics)
+
+
+def mempool_metrics() -> MempoolMetrics:
+    return _singleton("mempool", MempoolMetrics)
+
+
+def state_metrics() -> StateMetrics:
+    return _singleton("state", StateMetrics)

@@ -54,10 +54,17 @@ class RPCProvider(Provider):
             commit = commit_from_json(cm["signed_header"]["commit"])
             vals_pages = []
             page = 1
-            while True:
+            # Bound pagination against a malicious provider: an
+            # inflated `total` with empty pages must not spin forever
+            # (reference http provider caps pages); a truncated set is
+            # caught downstream by the valset-hash check.
+            max_pages = 1 + (10_000 // 100)  # MaxVotesCount / per_page
+            while page <= max_pages:
                 v = await self.client.call("validators",
                                            height=header.height,
                                            page=page, per_page=100)
+                if not v["validators"]:
+                    break  # provider returned an empty page: stop
                 vals_pages.extend(v["validators"])
                 if len(vals_pages) >= int(v["total"]):
                     break

@@ -228,6 +228,9 @@ class CListMempool(Mempool):
         if res.code != abci.CODE_TYPE_OK:
             if not self.config.keep_invalid_txs_in_cache:
                 self.cache.remove(key)
+            from ..libs.metrics import mempool_metrics
+
+            mempool_metrics().failed_txs.inc()
             return res
 
         # Re-check capacity: it may have filled while awaiting the app.
@@ -245,6 +248,11 @@ class CListMempool(Mempool):
         e = self.txs.push_back(mtx)
         self.tx_map[key] = e
         self._tx_bytes += len(tx)
+        from ..libs.metrics import mempool_metrics
+
+        met = mempool_metrics()
+        met.size.set(self.size())
+        met.tx_size_bytes.observe(len(tx))
         if self._wal:
             # buffered; flushed per block in _rewrite_wal (a hard crash
             # loses at most the buffer — the WAL is best-effort refill,
@@ -310,7 +318,13 @@ class CListMempool(Mempool):
                 self._tx_bytes -= len(tx)
 
         if self.config.recheck and self.size() > 0:
+            from ..libs.metrics import mempool_metrics
+
+            mempool_metrics().recheck_times.inc(self.size())
             await self._recheck_txs()
+        from ..libs.metrics import mempool_metrics
+
+        mempool_metrics().size.set(self.size())
         self._rewrite_wal()
         if self.size() == 0:
             self._notify_available.clear()

@@ -229,6 +229,23 @@ class Node(Service):
             rhost, rport = _split_laddr(cfg.rpc.laddr)
             self.rpc_server, self.rpc_port = await serve(
                 self.rpc_env(), rhost, rport)
+        # pprof + Prometheus listeners (reference node.go:807-812,
+        # :873; config rpc.pprof_laddr / instrumentation.prometheus)
+        self.debug_server = None
+        if cfg.rpc.pprof_laddr:
+            from ..libs.debugsrv import DebugServer
+
+            dhost, dport = _split_laddr(cfg.rpc.pprof_laddr)
+            self.debug_server = DebugServer(dhost, dport)
+            self.pprof_port = await self.debug_server.start()
+        self.prometheus_server = None
+        if cfg.instrumentation.prometheus:
+            from ..libs.debugsrv import DebugServer
+
+            phost, pport = _split_laddr(
+                cfg.instrumentation.prometheus_listen_addr)
+            self.prometheus_server = DebugServer(phost or "0.0.0.0", pport)
+            self.prometheus_port = await self.prometheus_server.start()
         host, port = _split_laddr(cfg.p2p.laddr)
         await self.transport.listen(host, port)
         await self.switch.start()
@@ -259,11 +276,34 @@ class Node(Service):
             logger.info("state sync done at height %d; fast-syncing tail",
                         state.last_block_height)
         except Exception:
-            logger.exception("state sync failed")
+            # Do NOT leave the node a zombie (RPC up, never advancing):
+            # fall back to fast-sync/consensus from local state, like a
+            # node started without state sync would.
+            logger.exception(
+                "state sync failed; falling back to fast sync from "
+                "local state"
+            )
+            try:
+                # NB: bc_reactor.fast_sync is constructed False whenever
+                # state sync is enabled — consult the CONFIG flag.
+                if self.config.base.fast_sync:
+                    await self.bc_reactor.switch_to_fast_sync(self.state)
+                else:
+                    await self.consensus_state.start()
+            except Exception:
+                logger.exception(
+                    "fallback after state-sync failure also failed; "
+                    "stopping node"
+                )
+                await self.stop()
 
     async def on_stop(self) -> None:
         if self.rpc_server is not None:
             self.rpc_server.close()
+        if getattr(self, "debug_server", None) is not None:
+            self.debug_server.close()
+        if getattr(self, "prometheus_server", None) is not None:
+            self.prometheus_server.close()
         self.indexer_service.stop()
         if self.consensus_state.is_running:
             await self.consensus_state.stop()

@@ -153,6 +153,9 @@ class Switch(Service):
                 await self.stop_peer_for_error(peer, e)
                 raise
         self.logger.info("added peer %r (%d total)", peer, len(self.peers))
+        from ..libs.metrics import p2p_metrics
+
+        p2p_metrics().peers.set(len(self.peers))
         return peer
 
     # -- outbound --
@@ -205,6 +208,9 @@ class Switch(Service):
             return
         self.logger.info("stopping peer %r: %s", peer, reason)
         await self._remove_peer(peer, reason)
+        from ..libs.metrics import p2p_metrics
+
+        p2p_metrics().peers.set(len(self.peers))
         if peer.is_persistent() and self.is_running:
             addr = f"{peer.id}@{peer.socket_addr}" if peer.socket_addr else None
             for a in self.persistent_addrs:

@@ -8,9 +8,20 @@ node side (`SignerClient`) accepts that connection and then issues
 sign requests over it; it implements `types.PrivValidator` with
 async sign methods the consensus state machine awaits.
 
-Frames: 4-byte big-endian length + JSON object. Requests carry
-canonical proto payloads hex-encoded (votes/proposals ride their own
-wire codecs, not ad-hoc JSON)."""
+Security (reference parity: socket-based signers require
+SecretConnection): when both sides are given a connection identity key
+(`conn_key`), the link runs the Station-to-Station handshake from
+p2p/conn/secret_connection.py — authenticated ChaCha20-Poly1305 both
+ways — and each side may pin the peer's expected identity address.
+Additionally the client ALWAYS verifies returned signatures against
+the signer's validator pubkey and checks the signed payload matches
+what was requested (modulo the timestamp, which the signer may rewind
+per double-sign protection) — so even a compromised link cannot make
+the node gossip a vote it did not ask for.
+
+Frames: 4-byte big-endian length + JSON object (plaintext mode) or the
+SecretConnection message layer (authenticated mode). Requests carry
+canonical proto payloads hex-encoded."""
 
 from __future__ import annotations
 
@@ -40,24 +51,86 @@ def _write_frame(writer, obj: dict) -> None:
     writer.write(len(raw).to_bytes(4, "big") + raw)
 
 
+class _Link:
+    """One signer link: plaintext (reader, writer) or SecretConnection."""
+
+    def __init__(self, reader=None, writer=None, sc=None):
+        self._reader = reader
+        self._writer = writer
+        self._sc = sc
+
+    @classmethod
+    async def establish(cls, reader, writer, conn_key,
+                        expected_peer_addr: bytes | None) -> "_Link":
+        if conn_key is None:
+            if expected_peer_addr is not None:
+                raise RemoteSignError(
+                    "cannot pin a peer identity on a plaintext link"
+                )
+            return cls(reader, writer)
+        from ..p2p.conn.secret_connection import make_secret_connection
+
+        sc = await make_secret_connection(reader, writer, conn_key)
+        if expected_peer_addr is not None and \
+                sc.remote_pubkey.address() != expected_peer_addr:
+            sc.close()
+            raise RemoteSignError(
+                f"signer link peer identity mismatch: "
+                f"{sc.remote_pubkey.address().hex()}"
+            )
+        return cls(sc=sc)
+
+    async def recv(self) -> dict:
+        if self._sc is not None:
+            return json.loads(await self._sc.read_msg())
+        return await _read_frame(self._reader)
+
+    async def send(self, obj: dict) -> None:
+        if self._sc is not None:
+            await self._sc.write_msg(json.dumps(obj).encode())
+        else:
+            _write_frame(self._writer, obj)
+            await self._writer.drain()
+
+    def close(self) -> None:
+        if self._sc is not None:
+            self._sc.close()
+        elif self._writer is not None:
+            self._writer.close()
+
+
 class SignerServer:
     """Runs NEXT TO THE KEY: wraps a FilePV and answers sign requests
-    arriving on its connection (reference: privval/signer_server.go)."""
+    arriving on its connection (reference: privval/signer_server.go).
 
-    def __init__(self, pv: FilePV, chain_id: str):
+    conn_key: identity for the SecretConnection handshake (None =
+    plaintext, for unix-socket/test deployments only).
+    expected_node_addr: pin of the validator node's link identity."""
+
+    def __init__(self, pv: FilePV, chain_id: str, conn_key=None,
+                 expected_node_addr: bytes | None = None):
         self.pv = pv
         self.chain_id = chain_id
+        self.conn_key = conn_key
+        self.expected_node_addr = expected_node_addr
 
     async def serve_connection(self, reader, writer) -> None:
         try:
+            link = await _Link.establish(
+                reader, writer, self.conn_key, self.expected_node_addr
+            )
+        except Exception:
+            logger.exception("signer link handshake failed")
+            writer.close()
+            return
+        try:
             while True:
-                req = await _read_frame(reader)
-                _write_frame(writer, self._handle(req))
-                await writer.drain()
+                req = await link.recv()
+                await link.send(self._handle(req))
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:
-            writer.close()
+            link.close()
 
     def _handle(self, req: dict) -> dict:
         t = req.get("type")
@@ -104,23 +177,31 @@ class SignerServer:
 
 
 def serve_signer(pv: FilePV, chain_id: str, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, conn_key=None,
+                 expected_node_addr: bytes | None = None):
     """Listener-mode signer (for tests/tools): returns the asyncio
     server; the validator's SignerClient dials it."""
-    server = SignerServer(pv, chain_id)
+    server = SignerServer(pv, chain_id, conn_key, expected_node_addr)
     return asyncio.start_server(server.serve_connection, host, port)
 
 
 class SignerClient:
     """Runs IN THE NODE: implements PrivValidator over the socket
     (reference: privval/signer_client.go:16). One in-flight request at
-    a time (the consensus event loop is serialized anyway)."""
+    a time (the consensus event loop is serialized anyway).
 
-    def __init__(self, chain_id: str, timeout: float = 5.0):
+    conn_key: identity for the SecretConnection handshake (None =
+    plaintext). expected_signer_addr: pin of the signer's link
+    identity — with it set, nobody else can impersonate the signer
+    even with network reach."""
+
+    def __init__(self, chain_id: str, timeout: float = 5.0, conn_key=None,
+                 expected_signer_addr: bytes | None = None):
         self.chain_id = chain_id
         self.timeout = timeout
-        self._reader = None
-        self._writer = None
+        self.conn_key = conn_key
+        self.expected_signer_addr = expected_signer_addr
+        self._link: _Link | None = None
         self._lock = asyncio.Lock()
         self._pub_key = None
 
@@ -143,35 +224,41 @@ class SignerClient:
         return server.sockets[0].getsockname()[1]
 
     async def wait_connected(self) -> None:
-        self._reader, self._writer = await asyncio.wait_for(
-            self._connected, self.timeout)
+        reader, writer = await asyncio.wait_for(self._connected, self.timeout)
+        self._link = await asyncio.wait_for(
+            _Link.establish(reader, writer, self.conn_key,
+                            self.expected_signer_addr),
+            self.timeout,
+        )
         # cache the pub key eagerly: get_pub_key must stay sync for the
         # PrivValidator interface
-        resp = await self._call({"type": "pub_key"})
-        from ..crypto.ed25519 import Ed25519PubKey
-        self._pub_key = Ed25519PubKey(bytes.fromhex(resp["pub_key"]))
+        await self._fetch_pub_key()
 
     async def connect(self, reader, writer) -> None:
         """Direct wiring (tests)."""
-        self._reader, self._writer = reader, writer
+        self._link = await _Link.establish(
+            reader, writer, self.conn_key, self.expected_signer_addr
+        )
+        await self._fetch_pub_key()
+
+    async def _fetch_pub_key(self) -> None:
         resp = await self._call({"type": "pub_key"})
         from ..crypto.ed25519 import Ed25519PubKey
+
         self._pub_key = Ed25519PubKey(bytes.fromhex(resp["pub_key"]))
 
     def close(self) -> None:
-        if self._writer is not None:
-            self._writer.close()
+        if self._link is not None:
+            self._link.close()
         if getattr(self, "_server", None) is not None:
             self._server.close()
 
     async def _call(self, req: dict) -> dict:
-        if self._writer is None:
+        if self._link is None:
             raise RemoteSignError("signer not connected")
         async with self._lock:
-            _write_frame(self._writer, req)
-            await self._writer.drain()
-            resp = await asyncio.wait_for(_read_frame(self._reader),
-                                          self.timeout)
+            await self._link.send(req)
+            resp = await asyncio.wait_for(self._link.recv(), self.timeout)
         if resp.get("type") == "error":
             raise RemoteSignError(resp.get("error", "unknown"))
         return resp
@@ -191,6 +278,18 @@ class SignerClient:
                                  "chain_id": chain_id,
                                  "vote": vote.to_bytes().hex()})
         signed = Vote.from_bytes(bytes.fromhex(resp["vote"]))
+        # The signer may only change timestamp+signature; and the
+        # signature must verify against OUR validator key for the
+        # returned sign bytes — a hostile link cannot substitute
+        # another payload.
+        if (signed.type, signed.height, signed.round, signed.block_id,
+                signed.validator_address, signed.validator_index) != (
+                vote.type, vote.height, vote.round, vote.block_id,
+                vote.validator_address, vote.validator_index):
+            raise RemoteSignError("signer returned a different vote")
+        if not self._pub_key.verify_signature(
+                signed.sign_bytes(chain_id), signed.signature):
+            raise RemoteSignError("signer returned an invalid signature")
         vote.signature = signed.signature
         vote.timestamp = signed.timestamp
 
@@ -199,5 +298,13 @@ class SignerClient:
                                  "chain_id": chain_id,
                                  "proposal": proposal.to_bytes().hex()})
         signed = Proposal.from_bytes(bytes.fromhex(resp["proposal"]))
+        if (signed.height, signed.round, signed.pol_round,
+                signed.block_id) != (
+                proposal.height, proposal.round, proposal.pol_round,
+                proposal.block_id):
+            raise RemoteSignError("signer returned a different proposal")
+        if not self._pub_key.verify_signature(
+                signed.sign_bytes(chain_id), signed.signature):
+            raise RemoteSignError("signer returned an invalid signature")
         proposal.signature = signed.signature
         proposal.timestamp = signed.timestamp

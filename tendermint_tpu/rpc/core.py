@@ -356,6 +356,13 @@ class Environment:
 
     async def abci_query(self, ctx, path="", data="", height=0,
                          prove=False) -> dict:
+        # All-digit hex strings arrive int-coerced from URI params;
+        # re-render losslessly (hex data always has even length, so a
+        # leading zero is the only ambiguity — restore it by parity).
+        if isinstance(data, int):
+            data = str(data)
+            if len(data) % 2:
+                data = "0" + data
         res = await self.node.proxy_app.query.query(abci.RequestQuery(
             data=bytes.fromhex(data) if data else b"",
             path=path, height=int(height), prove=bool(prove)))

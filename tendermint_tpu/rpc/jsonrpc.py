@@ -131,6 +131,25 @@ class JSONRPCServer:
                 if headers.get("upgrade", "").lower() == "websocket":
                     await self._serve_websocket(reader, writer, headers)
                     return
+                if method == "GET" and target.partition("?")[0] == "/metrics":
+                    # Prometheus text exposition (reference serves this
+                    # on the instrumentation listener; we also serve it
+                    # here for one-port deployments).
+                    from ..libs.metrics import DEFAULT as METRICS
+
+                    keep = headers.get("connection", "").lower() != "close"
+                    text = METRICS.render_text().encode()
+                    writer.write(
+                        b"HTTP/1.1 200 OK\r\n"
+                        b"Content-Type: text/plain; version=0.0.4\r\n"
+                        b"Content-Length: " + str(len(text)).encode() +
+                        b"\r\nConnection: " +
+                        (b"keep-alive" if keep else b"close") +
+                        b"\r\n\r\n" + text)
+                    await writer.drain()
+                    if not keep:
+                        break
+                    continue
                 resp, keep = await self._dispatch_http(method, target,
                                                        body)
                 if headers.get("connection", "").lower() == "close":

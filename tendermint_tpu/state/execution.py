@@ -122,10 +122,27 @@ class BlockExecutor:
     def validate_block(self, state: State, block: Block) -> None:
         validate_block(state, block, self.evpool)
 
+    async def validate_block_async(self, state: State, block: Block) -> None:
+        """validate_block in a worker thread: the LastCommit signature
+        batch runs on device without freezing the event loop (gossip,
+        RPC and timeouts stay live during a mega-commit verify)."""
+        import asyncio
+
+        await asyncio.get_running_loop().run_in_executor(
+            None, validate_block, state, block, self.evpool
+        )
+
     async def apply_block(self, state: State, block_id: BlockID,
                           block: Block) -> tuple[State, int]:
         """Returns (new_state, retain_height). Raises on invalid block."""
-        self.validate_block(state, block)
+        from ..libs.metrics import state_metrics
+
+        with state_metrics().block_processing_seconds.time():
+            return await self._apply_block(state, block_id, block)
+
+    async def _apply_block(self, state: State, block_id: BlockID,
+                           block: Block) -> tuple[State, int]:
+        await self.validate_block_async(state, block)
 
         abci_responses = await self._exec_block_on_proxy_app(state, block)
 

@@ -71,11 +71,14 @@ def validate_block(state: State, block: Block, evidence_pool=None) -> None:
                 f"LastCommit has {len(block.last_commit.signatures)} sigs, "
                 f"need {len(state.last_validators)}"
             )
+        from ..libs.metrics import state_metrics
+
         try:
-            state.last_validators.verify_commit(
-                state.chain_id, state.last_block_id, h.height - 1,
-                block.last_commit,
-            )
+            with state_metrics().commit_verify_seconds.time():
+                state.last_validators.verify_commit(
+                    state.chain_id, state.last_block_id, h.height - 1,
+                    block.last_commit,
+                )
         except VerificationError as e:
             raise BlockValidationError(f"invalid LastCommit: {e}") from e
 

@@ -8,8 +8,11 @@ a peer has claimed a +2/3 majority for that block.
 
 The signature check supports two modes: the synchronous host path
 (verify=True, matching vote_set.go:203) and a pre-verified path used by
-the consensus micro-batching scheduler, which verifies many votes in
-one TPU batch FIRST and then commits them here with verify=False.
+the consensus micro-batching scheduler
+(consensus/state.py:ConsensusState._vote_scheduler), which verifies
+many votes in one TPU batch FIRST and then commits them here with
+verify=False. Every non-signature check (duplicate, conflict, index,
+address) re-runs at commit time in both modes.
 """
 
 from __future__ import annotations
@@ -84,6 +87,24 @@ class VoteSet:
 
     def size(self) -> int:
         return len(self.val_set)
+
+    def is_duplicate(self, vote: Vote) -> bool:
+        """True if an identical vote (index, block, signature) is
+        already tallied — used by the consensus micro-batch scheduler to
+        skip re-verifying gossip duplicates before they reach a device
+        lane (the in-set dup check in add_vote still runs at commit)."""
+        i = vote.validator_index
+        if not 0 <= i < len(self.votes):
+            return False
+        ex = self.votes[i]
+        if ex is None:
+            bv = self.votes_by_block.get(_block_key(vote.block_id))
+            ex = bv.votes[i] if bv is not None else None
+        return (
+            ex is not None
+            and _block_key(ex.block_id) == _block_key(vote.block_id)
+            and ex.signature == vote.signature
+        )
 
     def add_vote(self, vote: Vote | None, verify: bool = True) -> bool:
         """Returns True if the vote was added, False if it was a

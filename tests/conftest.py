@@ -8,6 +8,13 @@ CPU for determinism and to exercise multi-chip sharding paths.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # override any inherited axon/tpu setting
+# Keep the axon site hook from dialing the (possibly absent) TPU tunnel
+# at interpreter start in subprocess nodes spawned by tests.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# Persistent XLA compilation cache: the verify kernel is a large program
+# (SHA-512 + curve math in one jit); caching makes reruns start fast.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/tm_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

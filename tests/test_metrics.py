@@ -1,0 +1,119 @@
+"""Metrics registry + debug/pprof server + /metrics RPC route."""
+
+import asyncio
+
+from tendermint_tpu.libs.metrics import (
+    DEFAULT, Counter, Gauge, Histogram, Registry,
+    consensus_metrics, crypto_metrics,
+)
+
+
+def test_counter_gauge_histogram_render():
+    reg = Registry()
+    c = reg.counter("reqs_total", "Requests.", "test")
+    c.inc()
+    c.inc(2, code="200")
+    g = reg.gauge("height", "Height.", "test")
+    g.set(42)
+    h = reg.histogram("lat", "Latency.", "test", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.render_text()
+    assert "# TYPE test_reqs_total counter" in text
+    assert 'test_reqs_total{code="200"} 2' in text
+    assert "test_height 42" in text
+    assert 'test_lat_bucket{le="0.1"} 1' in text
+    assert 'test_lat_bucket{le="+Inf"} 3' in text
+    assert "test_lat_count 3" in text
+
+
+def test_histogram_timer():
+    reg = Registry()
+    h = reg.histogram("t", "T.", "x")
+    with h.time():
+        pass
+    assert h.count == 1 and h.sum >= 0
+
+
+def test_module_singletons_registered():
+    cm = consensus_metrics()
+    assert consensus_metrics() is cm
+    cm.height.set(7)
+    km = crypto_metrics()
+    km.batch_lanes.inc(128, backend="tpu")
+    text = DEFAULT.render_text()
+    assert "consensus_height 7" in text
+    assert 'crypto_batch_lanes_total{backend="tpu"} 128' in text
+    # The registry carries a healthy metric surface (>= 15 metrics).
+    import tendermint_tpu.libs.metrics as M
+
+    M.p2p_metrics()
+    M.mempool_metrics()
+    M.state_metrics()
+    names = {m.name for m in DEFAULT._metrics}
+    assert len(names) >= 15, sorted(names)
+
+
+def test_batch_verifier_records_metrics():
+    from tendermint_tpu.crypto.batch import BatchVerifier
+    from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+
+    km = crypto_metrics()
+    before = km.batch_lanes.value(backend="host")
+    bad_before = km.invalid_sigs.value()
+    bv = BatchVerifier()
+    k = Ed25519PrivKey.from_secret(b"m")
+    bv.add(k.pub_key(), b"msg", k.sign(b"msg"))
+    bv.add(k.pub_key(), b"other", k.sign(b"msg"))
+    ok, verdicts = bv.verify()
+    assert not ok and verdicts.tolist() == [True, False]
+    assert km.batch_lanes.value(backend="host") == before + 2
+    assert km.invalid_sigs.value() == bad_before + 1
+
+
+def test_debug_server_routes():
+    from tendermint_tpu.libs.debugsrv import DebugServer
+
+    async def run():
+        srv = DebugServer()
+        port = await srv.start()
+
+        async def get(path):
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+            await w.drain()
+            data = await r.read()
+            w.close()
+            return data
+
+        idx = await get("/debug/pprof/")
+        assert b"pprof endpoints" in idx
+        goro = await get("/debug/pprof/goroutine")
+        assert b"asyncio tasks" in goro
+        heap = await get("/debug/pprof/heap")
+        assert b"tracemalloc" in heap
+        met = await get("/metrics")
+        assert b"# TYPE" in met
+        srv.close()
+
+    asyncio.run(run())
+
+
+def test_rpc_metrics_route():
+    from tendermint_tpu.rpc.jsonrpc import JSONRPCServer
+
+    async def run():
+        srv = JSONRPCServer(routes={})
+        port = await srv.listen("127.0.0.1", 0)
+
+        r, w = await asyncio.open_connection("127.0.0.1", port)
+        w.write(b"GET /metrics HTTP/1.1\r\nConnection: close\r\n\r\n")
+        await w.drain()
+        data = await r.read()
+        w.close()
+        assert b"200 OK" in data and b"# TYPE" in data
+
+        srv.close()
+
+    asyncio.run(run())

@@ -1,0 +1,163 @@
+"""sr25519 (schnorrkel/ristretto/merlin) and secp256k1 key types.
+
+Golden anchors:
+  - merlin transcript vector from the merlin crate's own test suite
+  - ristretto255 small-multiple encodings from RFC 9496 §A.1
+  - RIPEMD-160 standard vectors
+Plus structural sign/verify/tamper coverage and the mixed-key-type
+BatchVerifier path (BASELINE config #4: mixed ed25519+sr25519 set).
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto import ed25519 as ed25519_mod
+from tendermint_tpu.crypto import ed25519_ref as ed
+from tendermint_tpu.crypto import secp256k1 as secp
+from tendermint_tpu.crypto import sr25519 as sr_mod
+from tendermint_tpu.crypto import sr25519_ref as sr
+from tendermint_tpu.crypto.batch import BatchVerifier
+from tendermint_tpu.crypto.merlin import Transcript
+from tendermint_tpu.crypto.secp256k1 import _ripemd160_py
+
+
+def test_merlin_known_vector():
+    # From merlin's tests (transcript equivalence test).
+    t = Transcript(b"test protocol")
+    t.append_message(b"some label", b"some data")
+    c = t.challenge_bytes(b"challenge", 32)
+    assert c.hex() == (
+        "d5a21972d0d5fe320c0d263fac7fffb8145aa640af6e9bca177c03c7efcf0615"
+    )
+
+
+# RFC 9496 §A.1: encodings of B, 2B, ... (first four).
+_RISTRETTO_MULTIPLES = [
+    "0000000000000000000000000000000000000000000000000000000000000000",
+    "e2f2ae0a6abc4e71a884a961c500515f58e30b6aa582dd8db6a65945e08d2d76",
+    "6a493210f7499cd17fecb510ae0cea23a110e8d5b901f8acadd3095c73a3b919",
+    "94741f5d5d52755ece4f23f044ee27d5d1ea1e2bd196b462166b16152a9d0259",
+]
+
+
+def test_ristretto_small_multiples():
+    for k, want in enumerate(_RISTRETTO_MULTIPLES):
+        pt = ed.scalar_mult(k, ed._B_PT) if k else ed.IDENTITY
+        assert sr.ristretto_encode(pt).hex() == want, k
+
+
+def test_ristretto_decode_rejects():
+    assert sr.ristretto_decode(b"\x01" + bytes(31)) is None  # odd s
+    assert sr.ristretto_decode((sr.P).to_bytes(32, "little")) is None
+    assert sr.ristretto_decode(bytes(31)) is None  # wrong length
+    # round trips
+    for k in (1, 2, 3, 99, 31337):
+        enc = sr.ristretto_encode(ed.scalar_mult(k, ed._B_PT))
+        pt = sr.ristretto_decode(enc)
+        assert pt is not None and sr.ristretto_encode(pt) == enc
+
+
+def test_sr25519_sign_verify_tamper():
+    mini = hashlib.sha256(b"sr-test").digest()
+    pub = sr.public_key_from_mini(mini)
+    msg = b"precommit h=7 r=0"
+    sig = sr.sign(mini, msg)
+    assert len(sig) == 64 and sig[63] & 128
+    assert sr.verify(pub, msg, sig)
+    assert not sr.verify(pub, msg + b"!", sig)
+    bad = bytearray(sig)
+    bad[5] ^= 1
+    assert not sr.verify(pub, msg, bytes(bad))
+    # unmarked signature rejected (schnorrkel marker bit)
+    unmarked = sig[:63] + bytes([sig[63] & 0x7F])
+    assert not sr.verify(pub, msg, unmarked)
+    # non-canonical s rejected
+    s_int = int.from_bytes(sig[32:63] + bytes([sig[63] & 0x7F]), "little")
+    s_bad = (s_int + sr.L).to_bytes(32, "little")
+    if int.from_bytes(s_bad, "little") < 2**255:
+        forged = bytearray(sig[:32] + s_bad)
+        forged[63] |= 128
+        assert not sr.verify(pub, msg, bytes(forged))
+
+
+def test_sr25519_key_classes():
+    pk = sr_mod.Sr25519PrivKey.from_secret(b"validator-3")
+    pub = pk.pub_key()
+    sig = pk.sign(b"vote")
+    assert pub.verify_signature(b"vote", sig)
+    assert not pub.verify_signature(b"evot", sig)
+    assert len(pub.address()) == 20
+    assert pub.type_name == "sr25519"
+    from tendermint_tpu import crypto
+
+    rt = crypto.pubkey_from_type_and_bytes("sr25519", pub.bytes())
+    assert rt == pub
+
+
+def test_secp256k1_sign_verify():
+    pk = secp.Secp256k1PrivKey.from_secret(b"acct")
+    pub = pk.pub_key()
+    sig = pk.sign(b"tx bytes")
+    assert len(sig) == 64
+    assert pub.verify_signature(b"tx bytes", sig)
+    assert not pub.verify_signature(b"tx bytez", sig)
+    # high-S rejected even though mathematically valid
+    s = int.from_bytes(sig[32:], "big")
+    high = sig[:32] + (secp._N - s).to_bytes(32, "big")
+    assert not pub.verify_signature(b"tx bytes", high)
+    assert len(pub.address()) == 20
+
+
+def test_ripemd160_vectors():
+    assert _ripemd160_py(b"").hex() == (
+        "9c1185a5c5e9fc54612808977ee8f548b2258d31"
+    )
+    assert _ripemd160_py(b"abc").hex() == (
+        "8eb208f7e05d987a9b044a8e98c6b087f15a0bfc"
+    )
+    assert _ripemd160_py(b"a" * 1000).hex() == hashlib_ripemd(b"a" * 1000)
+
+
+def hashlib_ripemd(data):
+    try:
+        h = hashlib.new("ripemd160")
+        h.update(data)
+        return h.hexdigest()
+    except ValueError:
+        pytest.skip("openssl lacks ripemd160; vector-only coverage")
+
+
+def test_batch_verifier_mixed_key_types():
+    """BASELINE config #4: one batch mixing ed25519 + sr25519 (+secp)
+    lanes with per-lane verdicts in add order."""
+    bv = BatchVerifier()
+    expect = []
+    for i in range(24):
+        kind = i % 3
+        msg = b"mixed %d" % i
+        if kind == 0:
+            k = ed25519_mod.Ed25519PrivKey.from_secret(b"e%d" % i)
+        elif kind == 1:
+            k = sr_mod.Sr25519PrivKey.from_secret(b"s%d" % i)
+        else:
+            k = secp.Secp256k1PrivKey.from_secret(b"k%d" % i)
+        sig = k.sign(msg)
+        if i % 5 == 0:
+            msg = msg + b"~"  # tamper
+        bv.add(k.pub_key(), msg, sig)
+        expect.append(i % 5 != 0)
+    all_ok, verdicts = bv.verify()
+    assert verdicts.tolist() == expect
+    assert all_ok == all(expect)
+    assert not all_ok
+
+
+def test_batch_verifier_all_sr25519():
+    bv = BatchVerifier()
+    for i in range(8):
+        k = sr_mod.Sr25519PrivKey.from_secret(b"srb%d" % i)
+        bv.add(k.pub_key(), b"m%d" % i, k.sign(b"m%d" % i))
+    all_ok, verdicts = bv.verify()
+    assert all_ok and verdicts.all() and len(verdicts) == 8
