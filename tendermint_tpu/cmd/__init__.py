@@ -192,14 +192,29 @@ def cmd_light(args) -> int:
         lb = await cl.initialize()
         print(f"trusted root at height {lb.height()}: "
               f"{lb.hash().hex()[:16]}…")
-        while True:
-            new = await cl.update()
-            if new is not None:
-                print(f"verified height {new.height()}: "
-                      f"{new.hash().hex()[:16]}…")
-            if args.once:
-                return
-            await asyncio.sleep(args.interval)
+        proxy = None
+        if args.laddr:
+            from ..light.proxy import LightProxy
+            from ..rpc.jsonrpc import HTTPClient
+
+            lh, _, lp = args.laddr.rpartition(":")
+            proxy = LightProxy(
+                cl, forward_client=HTTPClient(host or "127.0.0.1",
+                                              int(port)))
+            p = await proxy.listen(lh or "127.0.0.1", int(lp))
+            print(f"light proxy: verified RPC on {lh or '127.0.0.1'}:{p}")
+        try:
+            while True:
+                new = await cl.update()
+                if new is not None:
+                    print(f"verified height {new.height()}: "
+                          f"{new.hash().hex()[:16]}…")
+                if args.once:
+                    return
+                await asyncio.sleep(args.interval)
+        finally:
+            if proxy is not None:
+                proxy.close()
 
     asyncio.run(run())
     return 0
@@ -329,6 +344,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--store", default="")
     sp.add_argument("--interval", type=float, default=1.0)
     sp.add_argument("--once", action="store_true")
+    sp.add_argument("--laddr", default="",
+                    help="host:port to serve verified RPC (light proxy)")
     sp.set_defaults(fn=cmd_light)
 
     sp = sub.add_parser("replay", help="replay the consensus WAL")

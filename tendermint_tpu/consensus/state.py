@@ -633,13 +633,14 @@ class ConsensusState(Service):
             except Exception as e:
                 self.logger.error("prune failed: %r", e)
 
-        self._record_commit_metrics(block, precommits)
+        self._record_commit_metrics(block, precommits,
+                                    rs.proposal_block_parts)
         self.update_to_state(new_state)
         self._height_done.set()
         self._height_done = asyncio.Event()
         self._schedule_round0()
 
-    def _record_commit_metrics(self, block, precommits) -> None:
+    def _record_commit_metrics(self, block, precommits, parts=None) -> None:
         """reference consensus/metrics.go recording (state.go:1612
         recordMetrics)."""
         from ..libs.metrics import consensus_metrics
@@ -657,7 +658,10 @@ class ConsensusState(Service):
         ntx = len(block.data.txs)
         met.num_txs.set(ntx)
         met.total_txs.inc(ntx)
-        met.block_size_bytes.set(len(block.to_bytes()))
+        # The part set already holds the serialized size — re-encoding
+        # the whole block here would add avoidable per-commit latency.
+        if parts is not None:
+            met.block_size_bytes.set(parts.byte_size)
         prev = self.block_store.load_block_meta(block.header.height - 1)
         if prev is not None:
             met.block_interval_seconds.observe(
@@ -786,31 +790,55 @@ class ConsensusState(Service):
             self._vote_pending.clear()
             if not batch:
                 continue
-            met.vote_batch_size.observe(len(batch))
             met.vote_batch_wait_seconds.observe(
                 _time.perf_counter() - t_window)
-            chain_id = self.state.chain_id
-            from ..crypto.batch import BatchVerifier
+            try:
+                await self._verify_and_commit_batch(batch, met, loop)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # One bad batch (device error, malformed-but-decodable
+                # vote, transient executor failure) must not kill this
+                # task: the node would keep enqueueing votes that no
+                # one ever verifies — consensus halting while gossip
+                # and RPC still look healthy. Fall back to the sync
+                # path vote by vote; irrecoverable votes are dropped
+                # with a log line, recoverable ones still tally.
+                self.logger.exception(
+                    "vote batch of %d failed; retrying via sync path",
+                    len(batch))
+                for vote, peer_id, _ in batch:
+                    try:
+                        async with self._state_mtx:
+                            await self._try_add_vote(vote, peer_id)
+                    except Exception:
+                        self.logger.exception(
+                            "dropping unprocessable vote from %r", peer_id)
 
-            bv = BatchVerifier()
-            for vote, _, pk in batch:
-                bv.add(pk, vote.sign_bytes(chain_id), vote.signature)
-            if len(batch) > 1:
-                # Device (or host-oracle) verify OFF the event loop:
-                # gossip, RPC and timeouts keep running during a
-                # 10k-lane commit verify.
-                _, verdicts = await loop.run_in_executor(None, bv.verify)
-            else:
-                _, verdicts = bv.verify()
-            for (vote, peer_id, _), ok in zip(batch, verdicts):
-                if not ok:
-                    self.logger.debug(
-                        "batch-verify rejected vote from %r (val %s)",
-                        peer_id, vote.validator_address.hex(),
-                    )
-                    continue
-                async with self._state_mtx:
-                    await self._try_add_vote(vote, peer_id, preverified=True)
+    async def _verify_and_commit_batch(self, batch, met, loop) -> None:
+        met.vote_batch_size.observe(len(batch))
+        chain_id = self.state.chain_id
+        from ..crypto.batch import BatchVerifier
+
+        bv = BatchVerifier()
+        for vote, _, pk in batch:
+            bv.add(pk, vote.sign_bytes(chain_id), vote.signature)
+        if len(batch) > 1:
+            # Device (or host-oracle) verify OFF the event loop:
+            # gossip, RPC and timeouts keep running during a
+            # 10k-lane commit verify.
+            _, verdicts = await loop.run_in_executor(None, bv.verify)
+        else:
+            _, verdicts = bv.verify()
+        for (vote, peer_id, _), ok in zip(batch, verdicts):
+            if not ok:
+                self.logger.debug(
+                    "batch-verify rejected vote from %r (val %s)",
+                    peer_id, vote.validator_address.hex(),
+                )
+                continue
+            async with self._state_mtx:
+                await self._try_add_vote(vote, peer_id, preverified=True)
 
     async def _try_add_vote(self, vote: Vote, peer_id: str,
                             preverified: bool = False) -> bool:

@@ -97,11 +97,18 @@ def pack_batch(pubs, msgs, sigs) -> dict[str, np.ndarray]:
 
     All numpy-vectorized; no per-signature Python.
     """
-    from . import sha512 as sh
-
     n = len(pubs)
     a_raw = np.frombuffer(b"".join(pubs), np.uint8).reshape(n, 32)
     sig_raw = np.frombuffer(b"".join(sigs), np.uint8).reshape(n, 64)
+    return pack_arrays(a_raw, sig_raw, msgs)
+
+
+def pack_arrays(a_raw: np.ndarray, sig_raw: np.ndarray, msgs) -> dict[str, np.ndarray]:
+    """pack_batch core on pre-built (N, 32)/(N, 64) uint8 arrays (shared
+    with the expanded-valset path, which gathers pubkey rows by index)."""
+    from . import sha512 as sh
+
+    n = a_raw.shape[0]
     msg_pad, nblocks = sh.pad_messages(list(msgs), prefix_len=64)
     # Bucket the padded width to power-of-two block counts so kernel
     # shapes (and recompiles) stay bounded; extra blocks are zeros and
@@ -239,25 +246,23 @@ def _dummy_triple() -> tuple[bytes, bytes, bytes]:
 
 
 def _chunks(n: int) -> list[int]:
-    """Split n into power-of-two kernel launches so a 10,240-sig commit
-    runs as 8192+2048 instead of padding to 16384, while batch sizes
-    just under a bucket (e.g. 32767) pad into ONE launch rather than
-    fragmenting into up to 9: accept a bucket whenever padding waste is
-    <= 1/8 of it."""
+    """One power-of-two bucket per verify whenever n fits in a bucket.
+
+    Measured on the target device path: a kernel launch costs a fixed
+    ~50-100 ms dispatch round trip while padded lanes cost microseconds
+    of marginal compute, so splitting a 10,240-sig commit into 8192+2048
+    (round 1's policy, tuned for padding waste) doubles latency for
+    nothing. Pad up to ONE launch; only batches beyond _MAX_BATCH get
+    split, into _MAX_BATCH pieces plus one padded tail."""
     out = []
-    while n > 0:
-        if n >= _MAX_BATCH:
-            out.append(_MAX_BATCH)
-            n -= _MAX_BATCH
-            continue
+    while n >= _MAX_BATCH:
+        out.append(_MAX_BATCH)
+        n -= _MAX_BATCH
+    if n:
         up = _MIN_BATCH
         while up < n:
             up <<= 1
-        if up - n <= up >> 3 or up == _MIN_BATCH:
-            out.append(up)
-            return out
-        out.append(up >> 1)
-        n -= up >> 1
+        out.append(up)
     return out
 
 

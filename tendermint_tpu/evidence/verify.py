@@ -35,8 +35,18 @@ def verify_evidence(ev: Evidence, state, state_store, block_store) -> None:
         if vals is None:
             raise EvidenceError(f"no validator set at height {height}")
         verify_duplicate_vote(ev, state.chain_id, vals, header_time)
-    else:
-        raise EvidenceError(f"unknown evidence type {type(ev).__name__}")
+        return
+    from ..light.types import LightClientAttackEvidence
+
+    if isinstance(ev, LightClientAttackEvidence):
+        common_vals = state_store.load_validators(height)
+        if common_vals is None:
+            raise EvidenceError(f"no validator set at height {height}")
+        verify_light_client_attack(
+            ev, state.chain_id, common_vals, header_time, state_store,
+            block_store)
+        return
+    raise EvidenceError(f"unknown evidence type {type(ev).__name__}")
 
 
 def _committed_block_time(block_store, height: int) -> int:
@@ -85,3 +95,75 @@ def verify_duplicate_vote(ev: DuplicateVoteEvidence, chain_id: str,
     if not ok:
         which = "A" if not verdicts[0] else "B"
         raise EvidenceError(f"invalid signature on vote {which}")
+
+
+def verify_light_client_attack(ev, chain_id: str, common_vals,
+                               common_time: int, state_store,
+                               block_store) -> None:
+    """reference: evidence/verify.go:123 VerifyLightClientAttack.
+
+    The commit of the conflicting block must verify against OUR chain:
+    through the common-height valset with 1/3 trust when the fork is
+    non-adjacent (a lunatic attack forges later valsets, so only the
+    common ancestor's set is meaningful), or through the valset at that
+    exact height for a same-height equivocation. The recorded byzantine
+    set, powers and timestamp are re-derived and must match — they feed
+    ABCI punishment and must not be attacker-chosen.
+    """
+    from ..light.types import compute_byzantine_validators
+    from ..types.validator_set import VerificationError
+
+    cb = ev.conflicting_block
+    sh = cb.signed_header
+    c_height = sh.header.height
+
+    # Our header at the conflicting height — the evidence must actually
+    # conflict with the committed chain.
+    trusted_meta = block_store.load_block_meta(c_height)
+    if trusted_meta is None:
+        raise EvidenceError(
+            f"no committed header at conflicting height {c_height}")
+    if trusted_meta.header.hash() == sh.header.hash():
+        raise EvidenceError("conflicting block matches the committed chain")
+
+    # The conflicting block must be self-consistent (its commit signs
+    # its header; its valset matches the header's validators_hash).
+    try:
+        cb.validate_basic(chain_id)
+    except ValueError as e:
+        raise EvidenceError(f"invalid conflicting block: {e}") from e
+
+    try:
+        if ev.common_height != c_height:
+            # Non-adjacent fork: >= 1/3 of the common valset must have
+            # signed the conflicting block (reference verify.go:138).
+            common_vals.verify_commit_light_trusting(
+                chain_id, sh.commit, 1, 3)
+        else:
+            vals_at = state_store.load_validators(c_height)
+            if vals_at is None:
+                raise EvidenceError(
+                    f"no validator set at height {c_height}")
+            if sh.header.validators_hash != vals_at.hash():
+                raise EvidenceError(
+                    "equivocation evidence with foreign validator set")
+            vals_at.verify_commit_light(
+                chain_id, sh.commit.block_id, c_height, sh.commit)
+    except VerificationError as e:
+        raise EvidenceError(
+            f"conflicting commit failed verification: {e}") from e
+
+    expected = compute_byzantine_validators(
+        common_vals, trusted_meta.header, cb)
+    got = ev.byzantine_validators
+    if [(v.address, v.voting_power) for v in got] != \
+            [(v.address, v.voting_power) for v in expected]:
+        raise EvidenceError("byzantine validator set mismatch")
+    if not expected:
+        raise EvidenceError("attack implicates no known validators")
+    if ev.total_voting_power != common_vals.total_voting_power():
+        raise EvidenceError("total voting power mismatch")
+    if ev.timestamp != common_time:
+        raise EvidenceError(
+            f"evidence time {ev.timestamp} != common block time "
+            f"{common_time}")

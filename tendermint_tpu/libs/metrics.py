@@ -276,15 +276,20 @@ class StateMetrics:
 
 
 _SINGLETONS: dict[str, object] = {}
+_SINGLETONS_LOCK = threading.Lock()
 
 
 def _singleton(key: str, cls):
     # NOT setdefault(key, cls()): constructing the dataclass registers
     # its metrics into DEFAULT, so the constructor must only ever run
-    # once per key.
-    if key not in _SINGLETONS:
-        _SINGLETONS[key] = cls()
-    return _SINGLETONS[key]
+    # once per key — and under a lock, because these accessors are
+    # called from executor threads (BatchVerifier offload) as well as
+    # the event loop; a first-call race would double-register a whole
+    # metric family and corrupt the exposition output.
+    with _SINGLETONS_LOCK:
+        if key not in _SINGLETONS:
+            _SINGLETONS[key] = cls()
+        return _SINGLETONS[key]
 
 
 def consensus_metrics() -> ConsensusMetrics:

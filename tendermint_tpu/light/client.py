@@ -134,10 +134,18 @@ class Client:
     # -- skipping verification with bisection --
 
     async def _verify_skipping(self, trusted: LightBlock,
-                               target: LightBlock, now_ns: int) -> None:
+                               target: LightBlock, now_ns: int,
+                               provider: Provider | None = None,
+                               persist: bool = True) -> None:
         """reference client.go:683 verifySkipping. Iterative pivoting:
         keep a stack of unverified blocks; verify what we can against
-        the current trusted head, bisect when trust is insufficient."""
+        the current trusted head, bisect when trust is insufficient.
+
+        `provider` supplies pivot blocks (default: the primary);
+        `persist=False` verifies without touching the trusted store —
+        used to examine a witness's conflicting header, which must
+        never pollute the store."""
+        provider = provider or self.primary
         pending: list[LightBlock] = [target]
         cache: dict[int, LightBlock] = {target.height(): target}
         steps = 0
@@ -155,11 +163,12 @@ class Client:
                 if pivot_h in (trusted.height(), block.height()) or \
                         pivot_h in cache:
                     raise  # can't split further: genuine failure
-                pivot = await self.primary.light_block(pivot_h)
+                pivot = await provider.light_block(pivot_h)
                 cache[pivot_h] = pivot
                 pending.append(pivot)
                 continue
-            self.store.save(block)
+            if persist:
+                self.store.save(block)
             trusted = block
             pending.pop()
 
@@ -167,21 +176,133 @@ class Client:
 
     async def _detect_divergence(self, verified: LightBlock,
                                  now_ns: int) -> None:
-        """reference light/detector.go:28 detectDivergence."""
+        """reference light/detector.go:28 detectDivergence.
+
+        A witness that merely DISAGREES is not yet an attack: it must
+        PROVE its conflicting header from a block we both trust
+        (reference detector.go:120 examineConflictingHeaderAgainstTrace).
+        Witnesses that cannot prove their header are dropped and the
+        loop continues (one bad witness must not DoS the client); a
+        witness that proves a conflict means a real fork — evidence is
+        built against both sides, submitted to the opposing providers,
+        and DivergenceError (carrying the evidence) is raised."""
         if not self.witnesses:
             return
         results = await asyncio.gather(
             *(self._compare_with_witness(i, w, verified)
               for i, w in enumerate(self.witnesses)),
             return_exceptions=True)
-        for i, res in enumerate(results):
-            if isinstance(res, DivergenceError):
-                raise res
-            if isinstance(res, BaseException):
-                logger.warning("witness %d unreachable: %r", i, res)
+        faulty: list = []
+        try:
+            for i, res in enumerate(results):
+                if isinstance(res, DivergenceError):
+                    if await self._examine_divergence(res, now_ns):
+                        raise res
+                    logger.warning(
+                        "witness %d could not prove its conflicting "
+                        "header; removing it", i)
+                    faulty.append(self.witnesses[i])
+                elif isinstance(res, BaseException):
+                    logger.warning("witness %d unreachable: %r", i, res)
+        finally:
+            if faulty:
+                self.witnesses = [w for w in self.witnesses
+                                  if w not in faulty]
 
     async def _compare_with_witness(self, idx: int, witness: Provider,
                                     verified: LightBlock) -> None:
         wb = await witness.light_block(verified.height())
         if wb.hash() != verified.hash():
             raise DivergenceError(idx, wb, verified)
+
+    async def _examine_divergence(self, div: DivergenceError,
+                                  now_ns: int) -> bool:
+        """Try to verify the witness's conflicting block from the last
+        height the witness and our (primary-derived) store agree on.
+        Returns True — after building + submitting attack evidence —
+        when the witness proves a genuine fork; False when the witness
+        fails to prove its header (caller drops it)."""
+        witness = self.witnesses[div.witness_index]
+        target_h = div.primary_block.height()
+        common = await self._find_common_block(witness, target_h)
+        if common is None:
+            return False
+        try:
+            await self._verify_skipping(
+                common, div.witness_block, now_ns,
+                provider=witness, persist=False)
+        except (LightClientError, ValueError):
+            # ValueError: structural validate_basic failures — the
+            # witness's block is not even well-formed.
+            return False
+        await self._report_attack(common, div, witness)
+        # The fork is PROVEN: every primary-derived block above the
+        # common height may be the attacker's — including the target
+        # already saved by _verify_skipping. Purge them so later calls
+        # cannot silently serve the forged chain from the store cache
+        # (reference: the detector returns ErrLightClientAttack and the
+        # client stops trusting the primary's trace).
+        for h in self.store.heights():
+            if h > common.height():
+                self.store.delete(h)
+        return True
+
+    async def _find_common_block(self, witness: Provider,
+                                 below: int) -> LightBlock | None:
+        """Latest stored (trusted) block strictly below `below` whose
+        hash the witness also reports (reference detector.go walks the
+        primary trace backwards the same way)."""
+        for h in sorted(self.store.heights(), reverse=True):
+            if h >= below:
+                continue
+            ours = self.store.get(h)
+            if ours is None:
+                continue
+            try:
+                theirs = await witness.light_block(h)
+            except Exception:
+                # Transient provider failure at ONE height must not
+                # make a genuine fork look "unprovable" (which would
+                # drop an honest witness and suppress the evidence);
+                # keep walking down.
+                continue
+            if theirs.hash() == ours.hash():
+                return ours
+        return None
+
+    async def _report_attack(self, common: LightBlock,
+                             div: DivergenceError,
+                             witness: Provider) -> None:
+        """Build LightClientAttackEvidence for BOTH sides of the fork
+        and hand each to the opposing provider (reference
+        detector.go:234 handleConflictingHeaders): we cannot know which
+        chain is canonical, but each full node can — it verifies the
+        evidence against its own chain and discards the half that
+        matches it."""
+        from .types import (
+            LightClientAttackEvidence, compute_byzantine_validators,
+        )
+
+        def build(conflicting: LightBlock, trusted: LightBlock):
+            return LightClientAttackEvidence(
+                conflicting_block=conflicting,
+                common_height=common.height(),
+                byzantine_validators=compute_byzantine_validators(
+                    common.validator_set,
+                    trusted.signed_header.header,
+                    conflicting,
+                ),
+                total_voting_power=common.validator_set.total_voting_power(),
+                timestamp=common.time(),
+            )
+
+        ev_vs_witness = build(div.witness_block, div.primary_block)
+        ev_vs_primary = build(div.primary_block, div.witness_block)
+        div.evidence = [ev_vs_witness, ev_vs_primary]
+        for provider, ev in ((self.primary, ev_vs_witness),
+                             (witness, ev_vs_primary)):
+            try:
+                await provider.report_evidence(ev)
+            except Exception as e:  # best-effort: the fork is already fatal
+                logger.warning("could not report evidence to %s: %r",
+                               provider.provider_id(), e)

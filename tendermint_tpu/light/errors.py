@@ -28,6 +28,9 @@ class DivergenceError(LightClientError):
         self.witness_index = witness_index
         self.witness_block = witness_block
         self.primary_block = primary_block
+        # Filled by the detector once the fork is proven: the two
+        # LightClientAttackEvidence objects submitted to each side.
+        self.evidence: list = []
         super().__init__(
             f"witness {witness_index} header conflicts with primary at "
             f"height {primary_block.height()}")

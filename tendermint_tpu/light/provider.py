@@ -24,6 +24,11 @@ class Provider:
         """height 0 → latest. Raises BlockNotFoundError."""
         raise NotImplementedError
 
+    async def report_evidence(self, ev) -> None:
+        """Submit LightClientAttackEvidence to the node behind this
+        provider (reference: light/provider ReportEvidence). Default:
+        nowhere to send it."""
+
     def provider_id(self) -> str:
         return repr(self)
 
@@ -74,15 +79,33 @@ class RPCProvider(Provider):
             raise BlockNotFoundError(str(e)) from e
         return LightBlock(SignedHeader(header, commit), vals)
 
+    async def report_evidence(self, ev) -> None:
+        import base64
+
+        from ..rpc.jsonrpc import RPCError
+
+        try:
+            await self.client.call(
+                "broadcast_evidence",
+                evidence=base64.b64encode(ev.to_bytes()).decode())
+        except RPCError as e:
+            raise ProviderError(str(e)) from e
+
 
 class BlockStoreProvider(Provider):
     """Serves from a full node's block store + state store
     (reference: the local rpc core behaviour light clients hit)."""
 
-    def __init__(self, block_store, state_store, name: str = "local"):
+    def __init__(self, block_store, state_store, name: str = "local",
+                 evidence_pool=None):
         self.block_store = block_store
         self.state_store = state_store
         self.name = name
+        self.evidence_pool = evidence_pool
+
+    async def report_evidence(self, ev) -> None:
+        if self.evidence_pool is not None:
+            self.evidence_pool.add_evidence(ev)
 
     def provider_id(self) -> str:
         return self.name

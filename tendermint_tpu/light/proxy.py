@@ -1,0 +1,170 @@
+"""Light proxy: a JSON-RPC server whose block-bearing responses are
+LIGHT-VERIFIED before they leave the process (reference:
+light/proxy/proxy.go:16, light/proxy/routes.go).
+
+A wallet or indexer points at this proxy exactly as it would at a full
+node; the proxy forwards transaction submission and queries to the
+primary, but every header/commit/validator-set it returns has passed
+the light client's verification (sequential or skipping + witness
+cross-check), and every full block fetched from the primary is checked
+against the corresponding verified header hash. A lying primary
+cannot feed this proxy's clients a forged chain — the request fails
+instead.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..rpc.jsonrpc import JSONRPCServer, RPCError
+from .client import Client
+from .errors import LightClientError
+from .provider import BlockNotFoundError
+
+logger = logging.getLogger("light.proxy")
+
+
+class LightProxy:
+    """Serves verified RPC routes from a light `Client`.
+
+    forward_client: an ``HTTPClient`` to the primary's RPC, used for
+    pass-through routes (tx broadcast, abci queries, full blocks);
+    None disables those routes (verified-only mode, e.g. tests over a
+    BlockStoreProvider primary).
+    """
+
+    def __init__(self, client: Client, forward_client=None):
+        self.client = client
+        self.forward = forward_client
+        self.server = JSONRPCServer(self._routes())
+        self.port: int | None = None
+
+    async def listen(self, host: str, port: int) -> int:
+        self.port = await self.server.listen(host, port)
+        logger.info("light proxy serving verified RPC on %s:%d",
+                    host, self.port)
+        return self.port
+
+    def close(self) -> None:
+        self.server.close()
+
+    def _routes(self) -> dict:
+        routes = {
+            "status": self.status,
+            "commit": self.commit,
+            "validators": self.validators,
+            "block": self.block,
+            "header": self.header,
+            "health": self.health,
+        }
+        if self.forward is not None:
+            for name in ("broadcast_tx_sync", "broadcast_tx_async",
+                         "broadcast_tx_commit", "abci_query", "abci_info",
+                         "tx", "tx_search", "net_info",
+                         "broadcast_evidence"):
+                routes[name] = self._forwarder(name)
+        return routes
+
+    # -- verified routes --
+
+    async def _verified_block_at(self, height) -> "object":
+        h = int(height) if height else 0
+        try:
+            if h == 0:
+                lb = await self.client.update()
+                if lb is None:
+                    lb = self.client.trusted_light_block()
+            else:
+                lb = await self.client.verify_light_block_at_height(h)
+        except (LightClientError, BlockNotFoundError) as e:
+            raise RPCError(-32603, f"light verification failed: {e}")
+        if lb is None:
+            raise RPCError(-32603, "no trusted block yet")
+        return lb
+
+    async def health(self, ctx) -> dict:
+        return {}
+
+    async def status(self, ctx) -> dict:
+        lb = self.client.trusted_light_block()
+        if lb is None:
+            raise RPCError(-32603, "light client not initialized")
+        h = lb.signed_header.header
+        return {
+            "node_info": {
+                "network": h.chain_id,
+                "moniker": "light-proxy",
+                "version": "tendermint-tpu/light",
+            },
+            "sync_info": {
+                "latest_block_height": str(h.height),
+                "latest_block_hash": lb.hash().hex().upper(),
+                "latest_app_hash": h.app_hash.hex().upper(),
+                "latest_block_time": str(h.time),
+                "catching_up": False,
+            },
+        }
+
+    async def commit(self, ctx, height=None) -> dict:
+        from ..rpc.core import _commit_json, _header_json
+
+        lb = await self._verified_block_at(height)
+        return {
+            "signed_header": {
+                "header": _header_json(lb.signed_header.header),
+                "commit": _commit_json(lb.signed_header.commit),
+            },
+            "canonical": True,
+        }
+
+    async def header(self, ctx, height=None) -> dict:
+        from ..rpc.core import _header_json
+
+        lb = await self._verified_block_at(height)
+        return {"header": _header_json(lb.signed_header.header)}
+
+    async def validators(self, ctx, height=None, page=1,
+                         per_page=30) -> dict:
+        from ..rpc.core import _validator_json
+
+        lb = await self._verified_block_at(height)
+        vals = lb.validator_set
+        page, per_page = max(int(page), 1), min(max(int(per_page), 1), 100)
+        start = (page - 1) * per_page
+        sel = vals.validators[start:start + per_page]
+        return {"block_height": str(lb.height()),
+                "validators": [_validator_json(v) for v in sel],
+                "count": str(len(sel)), "total": str(len(vals))}
+
+    async def block(self, ctx, height=None) -> dict:
+        """Full block from the primary, checked hash-for-hash against
+        the light-verified header (reference routes.go BlockFn →
+        proxy verification)."""
+        if self.forward is None:
+            raise RPCError(-32601, "block pass-through not configured")
+        lb = await self._verified_block_at(height)
+        res = await self.forward.call("block", height=lb.height())
+        got = bytes.fromhex(res["block_id"]["hash"])
+        want = lb.hash()
+        if got != want:
+            raise RPCError(
+                -32603,
+                f"primary served block {got.hex()[:16]}… but the "
+                f"verified header at height {lb.height()} is "
+                f"{want.hex()[:16]}… — refusing to relay a forged block")
+        return res
+
+    # -- pass-through routes --
+
+    def _forwarder(self, name: str):
+        async def fwd(ctx, **params):
+            from ..rpc.jsonrpc import RPCError as ClientRPCError
+
+            try:
+                return await self.forward.call(name, **params)
+            except ClientRPCError as e:
+                raise RPCError(e.code, e.message, e.data)
+            except OSError as e:
+                raise RPCError(-32603, f"primary unreachable: {e}")
+
+        return fwd

@@ -61,6 +61,9 @@ class LightStore:
         return [int.from_bytes(k[len(_PREFIX):], "big")
                 for k, _ in self.db.iterate_prefix(_PREFIX)]
 
+    def delete(self, height: int) -> None:
+        self.db.delete(_key(height))
+
     def prune(self, keep: int) -> None:
         hs = self.heights()
         for h in hs[:-keep] if keep else hs:

@@ -303,15 +303,19 @@ class _Ctx:
 
 def _uri_param(v: str):
     """URI params arrive as strings; JSON-ify the obvious scalars
-    (reference uri handler's type coercion)."""
+    (reference uri handler's type coercion). Int-coerce ONLY when the
+    round trip is lossless: "0012" must stay a string — an all-digit
+    hex payload (e.g. abci_query data) with leading zeros would
+    otherwise be silently corrupted downstream."""
     if v in ("true", "false"):
         return v == "true"
     if v.startswith('"') and v.endswith('"') and len(v) >= 2:
         return v[1:-1]
     try:
-        return int(v)
+        n = int(v)
     except ValueError:
         return v
+    return n if str(n) == v else v
 
 
 # --- clients ------------------------------------------------------------------

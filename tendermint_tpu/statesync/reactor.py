@@ -41,7 +41,8 @@ class StateSyncReactor(Reactor):
         self.syncer: Syncer | None = None
         if state_provider is not None:
             self.syncer = Syncer(app_snapshot_conn, state_provider,
-                                 self._request_chunk, discovery_time)
+                                 self._request_chunk, discovery_time,
+                                 request_snapshots=self._request_snapshots)
 
     def get_channels(self) -> list[ChannelDescriptor]:
         return [
@@ -66,6 +67,12 @@ class StateSyncReactor(Reactor):
             sw.broadcast(SNAPSHOT_CHANNEL,
                          encode_ss_msg(SnapshotsRequestMessage()))
         return await self.syncer.sync_any()
+
+    def _request_snapshots(self) -> None:
+        sw = self.switch
+        if sw is not None:
+            sw.broadcast(SNAPSHOT_CHANNEL,
+                         encode_ss_msg(SnapshotsRequestMessage()))
 
     async def _request_chunk(self, peer_id: str, snapshot, index: int
                              ) -> None:
@@ -120,7 +127,7 @@ class StateSyncReactor(Reactor):
                         missing=not res.chunk)))
             elif isinstance(msg, ChunkResponseMessage):
                 if self.syncer is not None:
-                    self.syncer.add_chunk(msg)
+                    self.syncer.add_chunk(msg, peer.id)
             else:
                 raise ValueError("bad msg on chunk channel")
 

@@ -73,5 +73,17 @@ class SnapshotPool:
                 del self._peers[k]
                 self._snapshots.pop(k, None)
 
+    def remove_peer_snapshot(self, peer_id: str, snapshot: Snapshot) -> None:
+        """Dissociate ONE peer from ONE snapshot (it answered 'missing'
+        for a chunk); other peers holding the snapshot keep serving it."""
+        k = snapshot.key()
+        peers = self._peers.get(k)
+        if peers is None:
+            return
+        peers.discard(peer_id)
+        if not peers:
+            del self._peers[k]
+            self._snapshots.pop(k, None)
+
     def __len__(self) -> int:
         return len(self._snapshots)

@@ -12,6 +12,7 @@ import asyncio
 import logging
 
 from ..abci import types as abci
+from ..light.errors import LightClientError
 from .snapshots import Snapshot, SnapshotPool
 
 logger = logging.getLogger("statesync")
@@ -39,15 +40,20 @@ class _RejectFormat(StateSyncError):
 
 class Syncer:
     def __init__(self, app_snapshot_conn, state_provider,
-                 request_chunk, discovery_time: float = DISCOVERY_TIME):
+                 request_chunk, discovery_time: float = DISCOVERY_TIME,
+                 request_snapshots=None):
         self.app = app_snapshot_conn
         self.state_provider = state_provider
         self.request_chunk = request_chunk  # async (peer_id, snapshot, idx)
+        # sync callable: re-broadcast SnapshotsRequest (re-discovery
+        # after a snapshot goes stale under us)
+        self.request_snapshots = request_snapshots
         self.discovery_time = discovery_time
         self.pool = SnapshotPool()
         self._chunks: dict[int, bytes] = {}
         self._chunk_event = asyncio.Event()
         self._active: Snapshot | None = None
+        self._requeue: set[int] = set()  # chunks whose peer said "missing"
 
     # -- inbound from reactor --
 
@@ -58,11 +64,24 @@ class Syncer:
                         snapshot.height, snapshot.format, peer_id[:8])
         return new
 
-    def add_chunk(self, msg) -> None:
+    def add_chunk(self, msg, peer_id: str = "") -> None:
         if self._active is None or msg.height != self._active.height or \
                 msg.format != self._active.format:
             return
-        if msg.missing or msg.index in self._chunks:
+        if msg.missing:
+            # THIS peer advertised the snapshot but no longer has it
+            # (pruned while we were verifying/offering — common when
+            # the chain outpaces the fetch). Drop only the peer's
+            # association; other peers keep serving the snapshot, and
+            # the fetch loop re-requests the chunk from them at once.
+            # When no peers remain, _fetch_and_apply fails the snapshot
+            # and sync_any moves on to a fresher one.
+            if peer_id:
+                self.pool.remove_peer_snapshot(peer_id, self._active)
+            self._requeue.add(msg.index)
+            self._chunk_event.set()
+            return
+        if msg.index in self._chunks:
             return
         if not 0 <= msg.index < self._active.chunks:
             return
@@ -97,10 +116,22 @@ class Syncer:
             except _RejectSnapshot:
                 logger.info("snapshot h=%d rejected", snapshot.height)
                 self.pool.reject(snapshot)
-            except StateSyncError as e:
+            except (StateSyncError, LightClientError) as e:
+                # StateSyncError: chunk fetch/restore failed (e.g. the
+                # peer pruned the snapshot under us). LightClientError:
+                # the state provider could not — or will no longer,
+                # once the trusted head moved past a stale snapshot's
+                # height — verify its state. Both are snapshot-local.
                 logger.warning("snapshot h=%d failed: %s; trying next",
                                snapshot.height, e)
                 self.pool.reject(snapshot)
+                if self.request_snapshots is not None:
+                    # Peers may have taken fresher snapshots since the
+                    # initial discovery; ask again so the pool does not
+                    # drain to stale entries.
+                    self.request_snapshots()
+                    deadline = (asyncio.get_running_loop().time()
+                                + self.discovery_time)
 
     async def _sync(self, snapshot: Snapshot):
         # 1) the app hash we must end up with — light-verified FIRST so
@@ -119,6 +150,7 @@ class Syncer:
         # 3) fetch + apply chunks
         self._active = snapshot
         self._chunks = {}
+        self._requeue = set()
         try:
             await self._fetch_and_apply(snapshot)
         finally:
@@ -158,6 +190,8 @@ class Syncer:
         requested: dict[int, float] = {}
         loop = asyncio.get_running_loop()
         while applied < snapshot.chunks:
+            while self._requeue:
+                requested[self._requeue.pop()] = 0.0  # retry immediately
             peers = self.pool.peers_of(snapshot)
             if not peers:
                 raise StateSyncError("no peers hold the snapshot")
@@ -190,6 +224,8 @@ class Syncer:
                 return
             if not progressed:
                 self._chunk_event.clear()
+                if applied in self._chunks or self._requeue:
+                    continue  # work arrived before the clear: no wait
                 try:
                     await asyncio.wait_for(self._chunk_event.wait(),
                                            CHUNK_TIMEOUT)

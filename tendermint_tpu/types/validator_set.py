@@ -4,8 +4,12 @@
 The verify_commit* family is the framework's north-star surface: where
 the reference loops `PubKey.VerifySignature` per signature
 (validator_set.go:683-705,720-762,776-824), every variant here collects
-its exact verification set first and executes it as ONE BatchVerifier
-call (TPU-wide batch, per-lane verdicts)."""
+its exact verification set first and executes it as ONE device batch
+with per-lane verdicts. Large all-ed25519 sets additionally route
+through crypto/tpu/expanded.py: per-validator comb tables cached on
+device across heights (the valset persists block to block), which
+removes pubkey decompression and all scalar-mul doublings from the
+per-commit critical path."""
 
 from __future__ import annotations
 
@@ -16,6 +20,11 @@ from .validator import Validator
 
 MAX_TOTAL_VOTING_POWER = (1 << 62) // 8
 PRIORITY_WINDOW_SIZE_FACTOR = 2
+# Lanes at/above this go through the expanded per-validator comb
+# tables (crypto/tpu/expanded.py MIN_EXPAND); below it the general
+# batch kernel / host path wins because the table build + HBM
+# residency don't amortize.
+_EXPAND_MIN = 128
 
 
 class VerificationError(Exception):
@@ -193,13 +202,35 @@ class ValidatorSet:
 
     # -- commit verification (batched; the hot path) --
 
+    def _batch_verify_lanes(self, lanes: list[int], msgs: list[bytes],
+                            sigs: list[bytes]):
+        """One device batch over (self.validators[lanes[i]], msgs[i],
+        sigs[i]). Large all-ed25519 sets go through the expanded
+        per-validator comb tables (cached on device across heights —
+        see crypto/tpu/expanded.py); everything else through the
+        general BatchVerifier."""
+        if len(lanes) >= _EXPAND_MIN and all(
+                self.validators[i].pub_key.type_name == "ed25519"
+                for i in lanes):
+            from ..crypto.tpu import expanded
+
+            exp = expanded.get_expanded(
+                [v.pub_key.bytes() for v in self.validators])
+            verdicts = exp.verify(lanes, msgs, sigs)
+            return bool(verdicts.all()), verdicts
+        bv = BatchVerifier()
+        for i, m, s in zip(lanes, msgs, sigs):
+            bv.add(self.validators[i].pub_key, m, s)
+        return bv.verify()
+
     def verify_commit(self, chain_id: str, block_id: BlockID, height: int,
                       commit) -> None:
         """Verify ALL non-absent signatures; tally for-block power must
         exceed 2/3 (reference: validator_set.go:662)."""
         self._check_commit_basics(block_id, height, commit)
-        bv = BatchVerifier()
         lanes: list[int] = []
+        msgs: list[bytes] = []
+        sigs: list[bytes] = []
         tallied = 0
         for idx, cs in enumerate(commit.signatures):
             if cs.is_absent():
@@ -209,11 +240,12 @@ class ValidatorSet:
                 raise VerificationError(
                     f"wrong validator address in slot {idx}"
                 )
-            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs.signature)
             lanes.append(idx)
+            msgs.append(commit.vote_sign_bytes(chain_id, idx))
+            sigs.append(cs.signature)
             if cs.for_block():
                 tallied += val.voting_power
-        ok, verdicts = bv.verify()
+        ok, verdicts = self._batch_verify_lanes(lanes, msgs, sigs)
         if not ok:
             bad = [lanes[i] for i in range(len(lanes)) if not verdicts[i]]
             raise VerificationError(f"invalid signature(s) at index(es) {bad}")
@@ -227,16 +259,18 @@ class ValidatorSet:
         """Verify only the for-block signatures needed to pass 2/3
         (reference: validator_set.go:720) — as one batch."""
         self._check_commit_basics(block_id, height, commit)
-        bv = BatchVerifier()
         lanes: list[int] = []
+        msgs: list[bytes] = []
+        sigs: list[bytes] = []
         tallied = 0
         need = 2 * self.total_voting_power()
         for idx, cs in enumerate(commit.signatures):
             if not cs.for_block():
                 continue
             val = self.validators[idx]
-            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs.signature)
             lanes.append(idx)
+            msgs.append(commit.vote_sign_bytes(chain_id, idx))
+            sigs.append(cs.signature)
             tallied += val.voting_power
             if 3 * tallied > need:
                 break
@@ -244,7 +278,7 @@ class ValidatorSet:
             raise VerificationError(
                 f"insufficient voting power: {tallied} of {self.total_voting_power()}"
             )
-        ok, verdicts = bv.verify()
+        ok, verdicts = self._batch_verify_lanes(lanes, msgs, sigs)
         if not ok:
             bad = [lanes[i] for i in range(len(lanes)) if not verdicts[i]]
             raise VerificationError(f"invalid signature(s) at index(es) {bad}")
@@ -256,8 +290,10 @@ class ValidatorSet:
         ADDRESS (the commit came from a possibly newer set)."""
         if trust_den <= 0 or trust_num <= 0 or trust_num > trust_den:
             raise ValueError("invalid trust level")
-        bv = BatchVerifier()
-        lanes: list[int] = []
+        lanes: list[int] = []  # OUR validator indices (for the tables)
+        slots: list[int] = []  # commit slots (for error reporting)
+        msgs: list[bytes] = []
+        sigs: list[bytes] = []
         tallied = 0
         need = self.total_voting_power() * trust_num
         seen: set[int] = set()
@@ -270,8 +306,10 @@ class ValidatorSet:
             if vi in seen:
                 raise VerificationError("double vote from same validator")
             seen.add(vi)
-            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs.signature)
-            lanes.append(idx)
+            lanes.append(vi)
+            slots.append(idx)
+            msgs.append(commit.vote_sign_bytes(chain_id, idx))
+            sigs.append(cs.signature)
             tallied += val.voting_power
             if tallied * trust_den > need:
                 break
@@ -279,9 +317,9 @@ class ValidatorSet:
             raise VerificationError(
                 f"insufficient trusted power: {tallied}"
             )
-        ok, verdicts = bv.verify()
+        ok, verdicts = self._batch_verify_lanes(lanes, msgs, sigs)
         if not ok:
-            bad = [lanes[i] for i in range(len(lanes)) if not verdicts[i]]
+            bad = [slots[i] for i in range(len(slots)) if not verdicts[i]]
             raise VerificationError(f"invalid signature(s) at index(es) {bad}")
 
     def _check_commit_basics(self, block_id: BlockID, height: int, commit) -> None:

@@ -31,12 +31,14 @@ class P2PNode:
     blockchain reactor optional (fast_sync)."""
 
     def __init__(self, gdoc, pv, moniker, fast_sync=False,
-                 snapshot_interval=0, state_provider_factory=None):
+                 snapshot_interval=0, state_provider_factory=None,
+                 keep_snapshots=4):
         self.gdoc = gdoc
         self.pv = pv
         self.moniker = moniker
         self.fast_sync = fast_sync
         self.snapshot_interval = snapshot_interval
+        self.keep_snapshots = keep_snapshots
         self.state_provider_factory = state_provider_factory
         self.node_key = NodeKey.generate()
         self.switch = None
@@ -47,7 +49,8 @@ class P2PNode:
         if wait_sync is None:
             wait_sync = self.fast_sync
         self.app = PersistentKVStoreApp(
-            MemDB(), snapshot_interval=self.snapshot_interval)
+            MemDB(), snapshot_interval=self.snapshot_interval,
+            keep_snapshots=self.keep_snapshots)
         self.conns = AppConns(ClientCreator(app=self.app))
         await self.conns.start()
         state_store = Store(MemDB())

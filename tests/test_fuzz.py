@@ -1,0 +1,344 @@
+"""Adversarial-bytes fuzzing of every wire decoder and the WAL
+(reference: consensus/wal_fuzz.go, p2p/conn/evil_secret_connection_test.go,
+the *_test.go decode-garbage cases).
+
+Contract under test: NO decoder may escape with anything but a clean,
+typed error (ValueError subclasses for codecs, WALCorruptionError /
+silent-stop for the WAL, AuthError/IncompleteReadError for the
+handshake) on ANY byte string. An unhandled exception from attacker-
+controlled bytes is a remote crash vector for the p2p layer.
+
+The corpus is deterministic: seeded random blobs + structured
+mutations (bit flips, truncations, splices) of VALID encodings, which
+reach much deeper than pure noise.
+"""
+
+import asyncio
+import os
+import random
+import struct
+import zlib
+
+import pytest
+
+from tendermint_tpu.blockchain.msgs import decode_bc_msg, encode_bc_msg
+from tendermint_tpu.consensus.messages import (
+    decode_consensus_msg, encode_consensus_msg,
+)
+from tendermint_tpu.consensus.wal import (
+    WAL, EndHeightMessage, MsgInfo, TimedWALMessage, TimeoutInfo,
+    WALCorruptionError, _decode_wal_msg, _encode_wal_msg,
+)
+from tendermint_tpu.encoding.proto import Reader, decode_varint
+from tendermint_tpu.evidence.reactor import (
+    decode_evidence_list, encode_evidence_list,
+)
+from tendermint_tpu.mempool.reactor import decode_txs, encode_txs
+from tendermint_tpu.statesync.messages import (
+    ChunkRequestMessage, decode_ss_msg, encode_ss_msg,
+)
+from tendermint_tpu.types.block import Block, Commit, Header
+from tendermint_tpu.types.evidence import evidence_from_bytes
+from tendermint_tpu.types.vote import Vote
+
+ROUNDS = 400
+
+# Exceptions a decoder is ALLOWED to raise on garbage: typed, clean,
+# catchable. Anything else (AttributeError, IndexError, struct.error,
+# KeyError, RecursionError...) is a bug.
+CLEAN = (ValueError,)  # UnicodeDecodeError/binascii subclass ValueError
+
+
+def _rng(tag: str) -> random.Random:
+    return random.Random(f"tm-tpu-fuzz-{tag}")
+
+
+def _mutations(rng: random.Random, seeds: list[bytes]):
+    """Random blobs + structured mutations of valid encodings."""
+    for i in range(ROUNDS):
+        kind = i % 4
+        if kind == 0 or not seeds:
+            yield rng.randbytes(rng.randrange(0, 300))
+            continue
+        base = bytearray(rng.choice(seeds))
+        if kind == 1 and base:  # bit flips
+            for _ in range(rng.randrange(1, 6)):
+                p = rng.randrange(len(base))
+                base[p] ^= 1 << rng.randrange(8)
+            yield bytes(base)
+        elif kind == 2:  # truncate / extend
+            cut = rng.randrange(0, len(base) + 1)
+            yield bytes(base[:cut]) + rng.randbytes(rng.randrange(0, 20))
+        else:  # splice two seeds
+            other = rng.choice(seeds)
+            p = rng.randrange(0, len(base) + 1)
+            q = rng.randrange(0, len(other) + 1)
+            yield bytes(base[:p]) + bytes(other[q:])
+
+
+def _assert_clean(decoder, corpus_tag: str, seeds: list[bytes]):
+    rng = _rng(corpus_tag)
+    for blob in _mutations(rng, seeds):
+        try:
+            decoder(blob)
+        except CLEAN:
+            pass
+        # anything else propagates and fails the test with the blob in
+        # the traceback via pytest's assertion machinery
+
+
+# -- valid seeds ---------------------------------------------------------------
+
+
+def _vote_seed() -> bytes:
+    from tendermint_tpu.types.block import BlockID, PartSetHeader
+    from tendermint_tpu.types.vote import VoteType
+
+    v = Vote(type=VoteType.PRECOMMIT, height=7, round=1,
+             block_id=BlockID(b"\xaa" * 32, PartSetHeader(1, b"\xbb" * 32)),
+             timestamp=1_700_000_000_000_000_000,
+             validator_address=b"\x01" * 20, validator_index=2)
+    v.signature = b"\x02" * 64
+    return v.to_bytes()
+
+
+def _consensus_seeds() -> list[bytes]:
+    from tendermint_tpu.consensus.messages import (
+        HasVoteMessage, NewRoundStepMessage, VoteMessage,
+    )
+
+    return [
+        encode_consensus_msg(NewRoundStepMessage(7, 0, 3, 12, 0)),
+        encode_consensus_msg(VoteMessage(Vote.from_bytes(_vote_seed()))),
+        encode_consensus_msg(HasVoteMessage(7, 0, 1, 2)),
+    ]
+
+
+def _evidence_seeds() -> list[bytes]:
+    from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+
+    a = Vote.from_bytes(_vote_seed())
+    b = Vote.from_bytes(_vote_seed())
+    b.block_id = type(a.block_id)(b"\xcc" * 32, a.block_id.part_set_header)
+    ev = DuplicateVoteEvidence(a, b, 40, 10, 5)
+    return [ev.to_bytes(), encode_evidence_list([ev])]
+
+
+# -- codec fuzz ----------------------------------------------------------------
+
+
+def test_fuzz_proto_reader_primitives():
+    rng = _rng("proto")
+    for blob in _mutations(rng, [b"\x08\x96\x01", b"\x12\x03abc"]):
+        try:
+            decode_varint(blob)
+        except CLEAN:
+            pass
+        try:
+            r = Reader(blob)
+            while not r.at_end():
+                f, wt = r.field()
+                r.skip(wt)
+        except CLEAN:
+            pass
+
+
+def test_fuzz_consensus_messages():
+    _assert_clean(decode_consensus_msg, "consensus", _consensus_seeds())
+
+
+def test_fuzz_statesync_messages():
+    seeds = [encode_ss_msg(ChunkRequestMessage(8, 1, 0))]
+    _assert_clean(decode_ss_msg, "statesync", seeds)
+
+
+def test_fuzz_blockchain_messages():
+    from tendermint_tpu.blockchain.msgs import BlockRequestMessage
+
+    seeds = [encode_bc_msg(BlockRequestMessage(5))]
+    _assert_clean(decode_bc_msg, "blockchain", seeds)
+
+
+def test_fuzz_mempool_txs():
+    seeds = [encode_txs([b"k=v", b"\x00" * 40])]
+    _assert_clean(decode_txs, "mempool", seeds)
+
+
+def test_fuzz_evidence():
+    seeds = _evidence_seeds()
+    _assert_clean(evidence_from_bytes, "evidence", seeds)
+    _assert_clean(decode_evidence_list, "evidence-list", seeds)
+
+
+def test_fuzz_core_types():
+    vote = _vote_seed()
+    _assert_clean(Vote.from_bytes, "vote", [vote])
+    _assert_clean(Header.from_bytes, "header", [vote])
+    _assert_clean(Commit.from_bytes, "commit", [vote])
+    _assert_clean(Block.from_bytes, "block", [vote])
+
+
+def test_fuzz_light_attack_evidence():
+    # mutate a REAL attack-evidence encoding: exercises the nested
+    # LightBlock / Validator / Commit decoders far deeper than noise
+    from test_light_attack import _Ctx, _attack_evidence, _conflicting_block
+
+    ctx = _Ctx()
+    ev = _attack_evidence(ctx, _conflicting_block(ctx, app_hash=b"\xee" * 32))
+    _assert_clean(evidence_from_bytes, "light-attack", [ev.to_bytes()])
+
+
+# -- WAL fuzz ------------------------------------------------------------------
+
+_FRAME = struct.Struct(">II")
+
+
+def _frame(body: bytes) -> bytes:
+    return _FRAME.pack(zlib.crc32(body), len(body)) + body
+
+
+def _wal_records() -> list[bytes]:
+    msgs = [
+        TimedWALMessage(1, EndHeightMessage(4)),
+        TimedWALMessage(2, MsgInfo("peer1", _vote_seed())),
+        TimedWALMessage(3, TimeoutInfo(1.5, 5, 0, 3)),
+    ]
+    return [_encode_wal_msg(m) for m in msgs]
+
+
+def test_fuzz_wal_decode_msg():
+    _assert_clean(_decode_wal_msg, "wal-msg", _wal_records())
+
+
+def test_fuzz_wal_file_decode_and_repair(tmp_path):
+    """Arbitrary file contents: decode_all(strict=False) NEVER raises;
+    strict mode raises only WALCorruptionError/ValueError; repair()
+    always leaves a file whose every record round-trips."""
+    rng = _rng("wal-file")
+    records = _wal_records()
+    valid_file = b"".join(_frame(r) for r in records)
+    for i, blob in enumerate(_mutations(rng, [valid_file])):
+        path = str(tmp_path / f"wal{i % 8}")
+        with open(path, "wb") as f:
+            f.write(blob)
+        msgs = WAL.decode_all(path)  # must not raise
+        try:
+            WAL.decode_all(path, strict=True)
+        except (WALCorruptionError, ValueError):
+            pass
+        # repair: whatever survives must re-decode to the same prefix
+        w = WAL(path)
+        try:
+            w.repair()
+            again = WAL.decode_all(path)
+            assert again == msgs[: len(again)]
+        finally:
+            w.close()
+
+
+def test_wal_crash_tail_repair(tmp_path):
+    """The classic crash shapes: torn frame, half record, garbage tail."""
+    records = _wal_records()
+    base = b"".join(_frame(r) for r in records)
+    for tail in (b"\xff" * 3, _frame(records[0])[:7],
+                 os.urandom(64), b"\x00" * _FRAME.size):
+        path = str(tmp_path / "wal")
+        with open(path, "wb") as f:
+            f.write(base + tail)
+        assert len(WAL.decode_all(path)) == len(records)
+        w = WAL(path)
+        try:
+            w.repair()
+        finally:
+            w.close()
+        assert os.path.getsize(path) == len(base)
+        assert len(WAL.decode_all(path)) == len(records)
+
+
+# -- secret connection / handshake fuzz ---------------------------------------
+
+
+def test_evil_handshake_garbage():
+    """A listener running make_secret_connection against adversarial
+    bytes must fail with a clean error (AuthError / IncompleteRead /
+    ValueError / Cryptography InvalidTag wrapped) — never hang, never
+    crash with an unrelated exception."""
+    from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+    from tendermint_tpu.p2p.conn.secret_connection import (
+        AuthError, make_secret_connection,
+    )
+
+    rng = _rng("handshake")
+
+    async def one(payload: bytes) -> None:
+        srv_key = Ed25519PrivKey.from_secret(b"srv")
+        done = asyncio.Event()
+        result: list = []
+
+        async def handle(reader, writer):
+            try:
+                await asyncio.wait_for(
+                    make_secret_connection(reader, writer, srv_key), 5)
+                result.append("accepted")
+            except (AuthError, ValueError, asyncio.IncompleteReadError,
+                    ConnectionError, asyncio.TimeoutError, EOFError):
+                result.append("clean")
+            except Exception as e:  # pragma: no cover
+                result.append(f"DIRTY: {e!r}")
+            finally:
+                writer.close()
+                done.set()
+
+        server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(payload)
+        try:
+            await writer.drain()
+            writer.write_eof()
+        except (ConnectionError, OSError):
+            pass
+        await asyncio.wait_for(done.wait(), 10)
+        writer.close()
+        server.close()
+        await server.wait_closed()
+        assert result and not result[0].startswith("DIRTY"), result
+
+    async def go():
+        # pure noise at several lengths incl. the exact ephemeral size,
+        # plus a valid-looking X25519 key followed by garbage AEAD frames
+        payloads = [
+            b"", b"\x00" * 31, rng.randbytes(32), rng.randbytes(33),
+            rng.randbytes(32) + rng.randbytes(64),
+            bytes(32) + b"\xff" * 200,
+        ] + [rng.randbytes(rng.randrange(0, 200)) for _ in range(10)]
+        for p in payloads:
+            await one(p)
+
+    asyncio.run(go())
+
+
+def test_evil_mconn_frames():
+    """Feed garbage into the multiplexed-connection frame decoder via a
+    raw socket pair; the recv side must error or close cleanly, not
+    crash the process with an unrelated exception."""
+    from tendermint_tpu.p2p.conn.connection import MConnection
+
+    rng = _rng("mconn")
+
+    async def go():
+        # MConnection drives its own read loop; we just assert that its
+        # frame-parse path rejects garbage via its error channel. Use
+        # the packet decoder directly if exposed; else skip gracefully.
+        import tendermint_tpu.p2p.conn.connection as C
+
+        decode = getattr(C, "decode_packet", None)
+        if decode is None:
+            pytest.skip("no standalone packet decoder exposed")
+        for blob in _mutations(rng, []):
+            try:
+                decode(blob)
+            except CLEAN:
+                pass
+
+    asyncio.run(go())

@@ -1,0 +1,115 @@
+"""Light proxy: verified RPC routes (reference: light/proxy/proxy.go,
+routes.go). A client pointed at the proxy only ever sees headers/
+commits/valsets that passed light verification, and full blocks are
+hash-checked against the verified header before being relayed."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.light import Client, LightStore, TrustOptions
+from tendermint_tpu.light.proxy import LightProxy
+from tendermint_tpu.rpc.jsonrpc import HTTPClient, RPCError
+
+from test_light import HOUR, LightChain, NOW, _client
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_proxy_serves_verified_routes():
+    """status/commit/header/validators over real HTTP, all backed by
+    the verifying client; block pass-through disabled without a
+    forward client."""
+    async def go():
+        chain = LightChain(10)
+        cl = _client(chain)
+        await cl.initialize()
+        proxy = LightProxy(cl, forward_client=None)
+        port = await proxy.listen("127.0.0.1", 0)
+        try:
+            http = HTTPClient("127.0.0.1", port)
+            cm = await http.call("commit", height=7)
+            assert int(cm["signed_header"]["header"]["height"]) == 7
+            # what the proxy served is exactly the verified chain
+            assert bytes.fromhex(
+                cm["signed_header"]["commit"]["block_id"]["hash"]) == \
+                chain.blocks[7].hash()
+            st = await http.call("status")
+            assert int(st["sync_info"]["latest_block_height"]) >= 7
+            vals = await http.call("validators", height=7)
+            assert int(vals["total"]) == 4
+            hd = await http.call("header", height=9)
+            assert int(hd["header"]["height"]) == 9
+            with pytest.raises(RPCError, match="not configured"):
+                await http.call("block", height=7)
+        finally:
+            proxy.close()
+
+    run(go())
+
+
+def test_proxy_refuses_forged_block():
+    """The primary serves a block whose hash doesn't match the
+    light-verified header: the proxy refuses to relay it."""
+    async def go():
+        chain = LightChain(6)
+        cl = _client(chain)
+        await cl.initialize()
+
+        class ForgingPrimary:
+            async def call(self, name, **params):
+                assert name == "block"
+                return {"block_id": {"hash": "ee" * 32}, "block": {}}
+
+        proxy = LightProxy(cl, forward_client=ForgingPrimary())
+        with pytest.raises(RPCError, match="forged"):
+            await proxy.block(None, height=5)
+
+    run(go())
+
+
+def test_proxy_against_live_node(tmp_path):
+    """End-to-end: full node with RPC; the proxy's forward path and
+    verified path agree, and tx broadcast passes through."""
+    async def go():
+        import base64
+
+        from test_rpc import start_node
+
+        node = await start_node(tmp_path)
+        try:
+            await node.consensus_state.wait_for_height(4, timeout=60)
+            from tendermint_tpu.light.provider import RPCProvider
+
+            prov = RPCProvider("127.0.0.1", node.rpc_port)
+            trusted = await prov.light_block(1)
+            cl = Client(
+                "rpc-chain",
+                TrustOptions(period_ns=HOUR, height=1,
+                             hash=trusted.hash()),
+                prov, [prov], LightStore(MemDB()),
+                now_fn=lambda: trusted.time() + HOUR // 2,
+            )
+            await cl.initialize()
+            proxy = LightProxy(
+                cl, forward_client=HTTPClient("127.0.0.1", node.rpc_port))
+            port = await proxy.listen("127.0.0.1", 0)
+            try:
+                http = HTTPClient("127.0.0.1", port)
+                blk = await http.call("block", height=3)
+                # proxied block is the node's real (verified) block 3
+                assert bytes.fromhex(blk["block_id"]["hash"]) == \
+                    node.block_store.load_block_meta(3).block_id.hash
+                res = await http.call(
+                    "broadcast_tx_sync",
+                    tx=base64.b64encode(b"lp=1").decode())
+                assert int(res["code"]) == 0
+            finally:
+                proxy.close()
+        finally:
+            await node.stop()
+
+    run(go())

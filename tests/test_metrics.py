@@ -41,10 +41,14 @@ def test_module_singletons_registered():
     assert consensus_metrics() is cm
     cm.height.set(7)
     km = crypto_metrics()
+    before = km.batch_lanes.value(backend="tpu")
     km.batch_lanes.inc(128, backend="tpu")
     text = DEFAULT.render_text()
     assert "consensus_height 7" in text
-    assert 'crypto_batch_lanes_total{backend="tpu"} 128' in text
+    from tendermint_tpu.libs.metrics import _fmt_value
+
+    assert (f'crypto_batch_lanes_total{{backend="tpu"}} '
+            f'{_fmt_value(before + 128)}') in text
     # The registry carries a healthy metric surface (>= 15 metrics).
     import tendermint_tpu.libs.metrics as M
 

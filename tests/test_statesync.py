@@ -116,6 +116,63 @@ def test_syncer_happy_path():
     run(go())
 
 
+def test_syncer_missing_chunk_falls_back_to_other_peer():
+    """One peer pruned the snapshot ('missing' reply): only ITS
+    association is dropped; the other peer serves the chunks and the
+    sync still completes on the same snapshot."""
+    async def go():
+        chunks = [b"c0", b"c1"]
+        app = ScriptedApp(chunks)
+        sy = Syncer(app, FakeStateProvider(), request_chunk=None)
+
+        async def feeder(peer_id, snapshot, idx):
+            if peer_id == "p1":  # p1 pruned it
+                sy.add_chunk(ChunkResponseMessage(
+                    snapshot.height, snapshot.format, idx, b"",
+                    missing=True), "p1")
+            else:
+                sy.add_chunk(ChunkResponseMessage(
+                    snapshot.height, snapshot.format, idx, chunks[idx]),
+                    "p2")
+
+        sy.request_chunk = feeder
+        snap = _snap(6, chunks=2)
+        sy.add_snapshot("p1", snap)
+        sy.add_snapshot("p2", snap)
+        state, _ = await asyncio.wait_for(sy.sync_any(), 10)
+        assert state == "state@6"
+        assert sy.pool.peers_of(snap) == ["p2"]  # p1 dissociated
+
+    run(go())
+
+
+def test_syncer_all_peers_missing_rejects_snapshot():
+    """Every holder pruned the snapshot: it is rejected and the syncer
+    moves on to another one instead of spinning on dead requests."""
+    async def go():
+        chunks = [b"c0"]
+        app = ScriptedApp(chunks)
+        sy = Syncer(app, FakeStateProvider(), request_chunk=None)
+
+        async def feeder(peer_id, snapshot, idx):
+            if snapshot.height == 8:  # stale: pruned everywhere
+                sy.add_chunk(ChunkResponseMessage(
+                    snapshot.height, snapshot.format, idx, b"",
+                    missing=True), peer_id)
+            else:
+                sy.add_chunk(ChunkResponseMessage(
+                    snapshot.height, snapshot.format, idx, chunks[idx]),
+                    peer_id)
+
+        sy.request_chunk = feeder
+        sy.add_snapshot("p1", _snap(8, chunks=1))  # best-ranked, stale
+        sy.add_snapshot("p1", _snap(6, chunks=1))
+        state, _ = await asyncio.wait_for(sy.sync_any(), 10)
+        assert state == "state@6"
+
+    run(go())
+
+
 def test_syncer_rejects_bad_app_hash_then_fails():
     async def go():
         chunks = [b"c0"]
@@ -183,7 +240,12 @@ def test_statesync_then_fastsync_then_consensus():
         gdoc, pvs = make_genesis(1)
         HOUR = 3600 * 10**9
 
-        a = P2PNode(gdoc, pvs[0], "full", snapshot_interval=2)
+        # Retain snapshots: the in-process net commits ~100 heights/s
+        # (skip_timeout_commit), so with the default keep_snapshots=4 a
+        # snapshot is pruned ~80ms after it is taken — faster than any
+        # real sync can fetch it. A serving full node keeps history.
+        a = P2PNode(gdoc, pvs[0], "full", snapshot_interval=2,
+                    keep_snapshots=10_000)
         await a.start()
         try:
             await a.cs.wait_for_height(8, timeout=60)

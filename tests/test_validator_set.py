@@ -187,7 +187,20 @@ class TestVerifyCommit:
 
     def test_large_commit_batch(self):
         """150-validator commit — the light-client baseline config —
-        runs through one BatchVerifier call."""
+        routes through the expanded per-validator comb tables
+        (crypto/tpu/expanded.py), cached across heights."""
+        from tendermint_tpu.crypto.tpu import expanded
+
         vs, privs = make_valset(150, power=1)
         commit, bid = make_commit(vs, privs)
         vs.verify_commit(CHAIN, bid, 5, commit)
+        key = [v.pub_key.bytes() for v in vs.validators]
+        assert expanded.get_expanded(key) is expanded.get_expanded(key)
+        # second height, same valset: tables reused, bad sig localized
+        commit2, bid2 = make_commit(vs, privs, height=6, bad_sig_idxs=(17,))
+        with pytest.raises(VerificationError, match=r"\[17\]"):
+            vs.verify_commit(CHAIN, bid2, 6, commit2)
+        # light + trusting variants share the same path
+        commit3, bid3 = make_commit(vs, privs, height=7)
+        vs.verify_commit_light(CHAIN, bid3, 7, commit3)
+        vs.verify_commit_light_trusting(CHAIN, commit3, 1, 3)
