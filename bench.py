@@ -9,19 +9,34 @@ vs_baseline = speedup over the reference's execution model: a
 sequential single-core CPU verify loop (types/validator_set.go:683-705)
 measured here with OpenSSL ed25519 (a *fast* CPU baseline — the
 reference's pure-Go verifier is slower).
+
+Resilience (round-2 lesson — a TPU-relay outage produced a bare
+traceback and a number-less round): the measurement runs in a worker
+subprocess; backend-init failures are retried with backoff, and the
+final failure still emits the JSON line, carrying an "error" field and
+diagnostics instead of a stack trace. A CPU-mesh fallback number is
+attached (flagged, never reported as the headline value).
 """
 
-import hashlib
 import json
 import os
+import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+METRIC = "ed25519_commit_verify_p50_10k_vals"
+ATTEMPTS = 3
+BACKOFF_S = 30
+ATTEMPT_TIMEOUT_S = 540
 
-def main():
-    import numpy as np
+
+def worker():
+    """Runs in a subprocess: do the measurement, print the JSON line."""
+    import hashlib
+
+    import numpy as np  # noqa: F401  (keeps import cost out of timings)
 
     from tendermint_tpu.crypto.tpu import verify as tv
 
@@ -86,6 +101,24 @@ def main():
         times.append(time.perf_counter() - t0)
     p50 = sorted(times)[len(times) // 2]
 
+    # Host/device breakdown of the same path: host = packing/padding
+    # (numpy), device = kernel launch to synced verdict on the packed
+    # arrays. They do not sum exactly to p50 (transfer overlap), but
+    # bound where the time goes.
+    host_t = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        pidx, packed, _wf = exp._prepare(idx, msgs, sigs)
+        host_t.append(time.perf_counter() - t0)
+    host_ms = sorted(host_t)[len(host_t) // 2] * 1e3
+    dev_t = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out_dev = exp._launch(pidx, packed)
+        out_dev.block_until_ready()
+        dev_t.append(time.perf_counter() - t0)
+    dev_ms = sorted(dev_t)[len(dev_t) // 2] * 1e3
+
     # Secondary: the general kernel (unknown keys — e.g. a light
     # client's first contact), one padded launch.
     out = tv.verify_batch(pubs, msgs, sigs)
@@ -102,13 +135,15 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "ed25519_commit_verify_p50_10k_vals",
+                "metric": METRIC,
                 "value": round(p50 * 1e3, 3),
                 "unit": "ms",
                 "vs_baseline": round(cpu_batch_s / p50, 2),
                 "sigs_per_sec": round(n / p50),
                 "batch": n,
                 "expanded_valset": True,
+                "host_pack_p50_ms": round(host_ms, 3),
+                "device_p50_ms": round(dev_ms, 3),
                 "cold_keys_p50_ms": round(cold_p50 * 1e3, 3),
                 "device": str(jax.devices()[0]),
                 "cpu_baseline_us_per_sig": round(cpu_per_sig * 1e6, 1),
@@ -118,5 +153,70 @@ def main():
     )
 
 
+def _run_attempt(env=None):
+    """One worker attempt; returns the JSON line or an error string."""
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            capture_output=True, text=True, timeout=ATTEMPT_TIMEOUT_S,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {ATTEMPT_TIMEOUT_S}s (backend hang?)"
+    for line in reversed(p.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                json.loads(line)
+                return line, None
+            except ValueError:
+                continue
+    tail = (p.stderr or p.stdout or "").strip().splitlines()
+    return None, f"rc={p.returncode}: " + " | ".join(tail[-3:])[-500:]
+
+
+def main():
+    errors = []
+    for attempt in range(ATTEMPTS):
+        line, err = _run_attempt()
+        if line is not None:
+            print(line)
+            return
+        errors.append(f"attempt {attempt + 1}: {err}")
+        if attempt < ATTEMPTS - 1:
+            time.sleep(BACKOFF_S)
+
+    # The accelerator never came up. Emit the JSON line anyway, with
+    # the failure recorded and a flagged CPU-mesh fallback number so
+    # the round is never number-less (VERDICT r2 weak #1).
+    fallback = {}
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    line, err = _run_attempt(env=env)
+    if line is not None:
+        d = json.loads(line)
+        fallback = {
+            "cpu_fallback_p50_ms": d.get("value"),
+            "cpu_fallback_device": d.get("device"),
+        }
+    else:
+        fallback = {"cpu_fallback_error": err}
+    print(
+        json.dumps(
+            {
+                "metric": METRIC,
+                "value": None,
+                "unit": "ms",
+                "vs_baseline": None,
+                "error": "; ".join(errors)[:2000],
+                "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+                **fallback,
+            }
+        )
+    )
+
+
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        worker()
+    else:
+        main()

@@ -188,16 +188,19 @@ class SocketClient(Client):
 
 
 class ClientCreator:
-    """Factory handed to proxy.AppConns: local app or remote addr
-    (reference: proxy/client.go NewLocalClientCreator/NewRemoteClientCreator)."""
+    """Factory handed to proxy.AppConns: local app, socket addr, or
+    gRPC addr (reference: proxy/client.go NewLocalClientCreator/
+    NewRemoteClientCreator with transport "socket"|"grpc")."""
 
     def __init__(self, app: t.Application | None = None,
                  addr: tuple[str, int] | None = None,
                  unix_path: str | None = None,
+                 grpc_addr: tuple[str, int] | None = None,
                  shared_lock: bool = True):
         self.app = app
         self.addr = addr
         self.unix_path = unix_path
+        self.grpc_addr = grpc_addr
         self._lock = asyncio.Lock() if (app is not None and shared_lock) else None
 
     def new_client(self) -> Client:
@@ -205,5 +208,9 @@ class ClientCreator:
             return LocalClient(self.app, self._lock)
         if self.unix_path is not None:
             return SocketClient(unix_path=self.unix_path)
+        if self.grpc_addr is not None:
+            from .grpc_client import GRPCClient
+
+            return GRPCClient(self.grpc_addr[0], self.grpc_addr[1])
         assert self.addr is not None
         return SocketClient(self.addr[0], self.addr[1])

@@ -1,0 +1,56 @@
+"""ABCI gRPC client (reference: abci/client/grpc_client.go).
+
+Each `deliver` is one unary RPC on the ABCIApplication service; gRPC
+does its own multiplexing/flow control, so unlike the socket client
+there is no FIFO future queue and `flush` degenerates to the Flush RPC
+(reference grpc_client.go keeps Flush for interface parity too).
+"""
+
+from __future__ import annotations
+
+import grpc
+from grpc import aio
+
+from . import types as t
+from .client import ABCIClientError, Client
+from .grpc_server import METHOD_BY_TYPE, SERVICE_NAME
+
+
+class GRPCClient(Client):
+    def __init__(self, host: str = "127.0.0.1", port: int = 26658):
+        super().__init__(name="abci.GRPCClient")
+        self.host, self.port = host, port
+        self._channel: aio.Channel | None = None
+        self._stubs: dict[str, object] = {}
+
+    async def on_start(self) -> None:
+        self._channel = aio.insecure_channel(f"{self.host}:{self.port}")
+
+    async def on_stop(self) -> None:
+        if self._channel is not None:
+            await self._channel.close()
+
+    def _stub(self, method: str):
+        stub = self._stubs.get(method)
+        if stub is None:
+            assert self._channel is not None, "client not started"
+            stub = self._channel.unary_unary(
+                f"/{SERVICE_NAME}/{method}",
+                request_serializer=t.encode_msg,
+                response_deserializer=t.decode_msg,
+            )
+            self._stubs[method] = stub
+        return stub
+
+    async def deliver(self, req):
+        method = METHOD_BY_TYPE.get(type(req))
+        if method is None:
+            raise ABCIClientError(f"unknown request {type(req).__name__}")
+        try:
+            return await self._stub(method)(req)
+        except aio.AioRpcError as e:
+            raise ABCIClientError(
+                f"{method}: {e.code().name}: {e.details()}") from e
+
+    async def flush(self) -> None:
+        await self.deliver(t.RequestFlush())

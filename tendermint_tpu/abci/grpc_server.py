@@ -1,0 +1,88 @@
+"""ABCI gRPC server for out-of-process apps (reference:
+abci/server/grpc_server.go).
+
+One unary RPC per ABCI method on the `tendermint.abci.ABCIApplication`
+service. Messages ride the same self-describing codec the socket
+transport uses (types.encode_msg/decode_msg), registered as the
+per-method (de)serializers, so both transports are byte-level
+interchangeable above the framing. App calls are serialized under one
+lock, matching the socket server (the reference's gRPC server relies
+on the app's own locking; ours keeps the stronger guarantee both our
+transports already give).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import grpc
+from grpc import aio
+
+from ..libs.service import Service
+from . import types as t
+
+SERVICE_NAME = "tendermint.abci.ABCIApplication"
+
+# RPC method name -> request type (Echo/Flush are transport-level).
+METHODS: dict[str, type] = {
+    "Echo": t.RequestEcho,
+    "Flush": t.RequestFlush,
+    "Info": t.RequestInfo,
+    "Query": t.RequestQuery,
+    "CheckTx": t.RequestCheckTx,
+    "InitChain": t.RequestInitChain,
+    "BeginBlock": t.RequestBeginBlock,
+    "DeliverTx": t.RequestDeliverTx,
+    "EndBlock": t.RequestEndBlock,
+    "Commit": t.RequestCommit,
+    "ListSnapshots": t.RequestListSnapshots,
+    "OfferSnapshot": t.RequestOfferSnapshot,
+    "LoadSnapshotChunk": t.RequestLoadSnapshotChunk,
+    "ApplySnapshotChunk": t.RequestApplySnapshotChunk,
+}
+METHOD_BY_TYPE: dict[type, str] = {v: k for k, v in METHODS.items()}
+
+
+class GRPCServer(Service):
+    def __init__(self, app: t.Application, host: str = "127.0.0.1",
+                 port: int = 26658):
+        super().__init__(name="abci.GRPCServer")
+        self.app = app
+        self.host, self.port = host, port
+        self._server: aio.Server | None = None
+        self._app_lock = asyncio.Lock()
+
+    def _make_handler(self, name: str):
+        async def unary(request, context):
+            if isinstance(request, t.RequestEcho):
+                return t.ResponseEcho(request.message)
+            if isinstance(request, t.RequestFlush):
+                return t.ResponseFlush()
+            method = t.HANDLERS[type(request)]
+            try:
+                async with self._app_lock:
+                    return getattr(self.app, method)(request)
+            except Exception as e:  # app bug -> RPC error, not dead server
+                self.logger.error("app %s failed: %r", method, e)
+                await context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+        return grpc.unary_unary_rpc_method_handler(
+            unary,
+            request_deserializer=t.decode_msg,
+            response_serializer=t.encode_msg,
+        )
+
+    async def on_start(self) -> None:
+        self._server = aio.server()
+        handlers = {name: self._make_handler(name) for name in METHODS}
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+        )
+        self.port = self._server.add_insecure_port(
+            f"{self.host}:{self.port}")
+        await self._server.start()
+        self.logger.info("abci grpc server on %s:%d", self.host, self.port)
+
+    async def on_stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=1.0)

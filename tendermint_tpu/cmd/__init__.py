@@ -1,8 +1,8 @@
 """Command-line interface (reference: cmd/tendermint/main.go:15-45).
 
 Subcommands: init, start, testnet, light, replay, unsafe-reset-all,
-gen-validator, show-validator, gen-node-key, show-node-id, version.
-argparse instead of cobra; same behaviors."""
+debug kill|dump, gen-validator, show-validator, gen-node-key,
+show-node-id, version. argparse instead of cobra; same behaviors."""
 
 from __future__ import annotations
 
@@ -354,6 +354,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("unsafe-reset-all",
                         help="wipe data, keep keys and config")
     sp.set_defaults(fn=cmd_unsafe_reset_all)
+
+    from .debug import register as register_debug
+
+    register_debug(sub)
 
     sub.add_parser("gen-validator").set_defaults(fn=cmd_gen_validator)
     sub.add_parser("show-validator").set_defaults(fn=cmd_show_validator)

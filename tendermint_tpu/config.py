@@ -22,7 +22,7 @@ class BaseConfig:
     priv_validator_key_file: str = "config/priv_validator_key.json"
     priv_validator_state_file: str = "data/priv_validator_state.json"
     node_key_file: str = "config/node_key.json"
-    abci: str = "builtin"  # builtin | socket
+    abci: str = "builtin"  # builtin | socket | grpc
     proxy_app: str = "kvstore"
 
     def resolve(self, path: str) -> str:
@@ -32,6 +32,7 @@ class BaseConfig:
 @dataclass
 class RPCConfig:
     laddr: str = "tcp://127.0.0.1:26657"
+    grpc_laddr: str = ""  # gRPC broadcast API (reference rpc/grpc)
     max_open_connections: int = 900
     max_subscription_clients: int = 100
     max_subscriptions_per_client: int = 5

@@ -198,16 +198,13 @@ class ExpandedKeys:
     def __len__(self) -> int:
         return len(self.pubkeys)
 
-    def verify(self, indices, msgs, sigs) -> np.ndarray:
-        """Verify (self.pubkeys[indices[i]], msgs[i], sigs[i]) lanes.
+    def _prepare(self, indices, msgs, sigs):
+        """Host side of verify: validate, pad to a bucket, pack bytes.
 
-        One kernel launch (padded to a power-of-two bucket); semantics
-        identical to verify.verify_batch on the same triples.
-        """
+        Split from the launch so callers (bench.py) can attribute
+        host-packing vs device time separately."""
         n = len(indices)
         assert len(msgs) == n and len(sigs) == n
-        if n == 0:
-            return np.zeros(0, bool)
         idx = np.asarray(indices, np.int32)
         assert n <= tv._MAX_BATCH, "split huge batches at the call site"
         assert idx.min() >= 0 and idx.max() < len(self.pubkeys)
@@ -236,13 +233,29 @@ class ExpandedKeys:
         a_raw = self._a_raw[idx]
         sig_raw = np.frombuffer(b"".join(sigs), np.uint8).reshape(bucket, 64)
         packed = tv.pack_arrays(a_raw, sig_raw, msgs)
-        out = _xkernel()(
+        return idx, packed, well_formed
+
+    def _launch(self, idx, packed):
+        """Device side of verify: one kernel launch over packed lanes."""
+        return _xkernel()(
             idx=idx,
             key_ok=self.key_ok,
             atab=self.tables,
             btab=tv.b_comb_tables(),
             **packed,
         )
+
+    def verify(self, indices, msgs, sigs) -> np.ndarray:
+        """Verify (self.pubkeys[indices[i]], msgs[i], sigs[i]) lanes.
+
+        One kernel launch (padded to a power-of-two bucket); semantics
+        identical to verify.verify_batch on the same triples.
+        """
+        n = len(indices)
+        if n == 0:
+            return np.zeros(0, bool)
+        idx, packed, well_formed = self._prepare(indices, msgs, sigs)
+        out = self._launch(idx, packed)
         return np.asarray(out)[:n] & well_formed
 
 

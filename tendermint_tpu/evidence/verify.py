@@ -110,21 +110,28 @@ def verify_light_client_attack(ev, chain_id: str, common_vals,
     set, powers and timestamp are re-derived and must match — they feed
     ABCI punishment and must not be attacker-chosen.
     """
-    from ..light.types import compute_byzantine_validators
+    from ..light.types import (
+        SignedHeader, compute_byzantine_validators,
+        conflicting_header_is_invalid,
+    )
     from ..types.validator_set import VerificationError
 
     cb = ev.conflicting_block
     sh = cb.signed_header
     c_height = sh.header.height
 
-    # Our header at the conflicting height — the evidence must actually
-    # conflict with the committed chain.
+    # Our signed header at the conflicting height — the evidence must
+    # actually conflict with the committed chain, and its commit round
+    # feeds the equivocation/amnesia classification below.
     trusted_meta = block_store.load_block_meta(c_height)
-    if trusted_meta is None:
+    trusted_commit = block_store.load_block_commit(c_height) or \
+        block_store.load_seen_commit(c_height)
+    if trusted_meta is None or trusted_commit is None:
         raise EvidenceError(
             f"no committed header at conflicting height {c_height}")
     if trusted_meta.header.hash() == sh.header.hash():
         raise EvidenceError("conflicting block matches the committed chain")
+    trusted_sh = SignedHeader(trusted_meta.header, trusted_commit)
 
     # The conflicting block must be self-consistent (its commit signs
     # its header; its valset matches the header's validators_hash).
@@ -140,6 +147,16 @@ def verify_light_client_attack(ev, chain_id: str, common_vals,
             common_vals.verify_commit_light_trusting(
                 chain_id, sh.commit, 1, 3)
         else:
+            # Same-height evidence must be a correctly-derived header
+            # (equivocation/amnesia); a lunatic header at the SAME
+            # height is nonsense — lunatic forks require an earlier
+            # common height (reference verify.go:135-139).
+            if conflicting_header_is_invalid(sh.header,
+                                             trusted_meta.header):
+                raise EvidenceError(
+                    "common height equals conflicting height, so the "
+                    "conflicting block must be correctly derived, but "
+                    "its deterministic header fields differ")
             vals_at = state_store.load_validators(c_height)
             if vals_at is None:
                 raise EvidenceError(
@@ -153,14 +170,14 @@ def verify_light_client_attack(ev, chain_id: str, common_vals,
         raise EvidenceError(
             f"conflicting commit failed verification: {e}") from e
 
-    expected = compute_byzantine_validators(
-        common_vals, trusted_meta.header, cb)
+    expected = compute_byzantine_validators(common_vals, trusted_sh, cb)
     got = ev.byzantine_validators
+    # Mismatch is attacker-chosen punishment data; an empty set that
+    # MATCHES the derivation is legitimate amnesia evidence (reference
+    # verify.go accepts a nil byzantine set for amnesia attacks).
     if [(v.address, v.voting_power) for v in got] != \
             [(v.address, v.voting_power) for v in expected]:
         raise EvidenceError("byzantine validator set mismatch")
-    if not expected:
-        raise EvidenceError("attack implicates no known validators")
     if ev.total_voting_power != common_vals.total_voting_power():
         raise EvidenceError("total voting power mismatch")
     if ev.timestamp != common_time:

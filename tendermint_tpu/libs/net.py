@@ -1,0 +1,11 @@
+"""Address helpers shared by node, CLI drivers, and app creators."""
+
+from __future__ import annotations
+
+
+def split_laddr(laddr: str,
+                default_host: str = "0.0.0.0") -> tuple[str, int]:
+    """'tcp://host:port' or 'host:port' -> (host, port)."""
+    addr = laddr[len("tcp://"):] if laddr.startswith("tcp://") else laddr
+    host, _, port = addr.rpartition(":")
+    return host or default_host, int(port)

@@ -196,8 +196,18 @@ class Client:
         try:
             for i, res in enumerate(results):
                 if isinstance(res, DivergenceError):
-                    if await self._examine_divergence(res, now_ns):
+                    outcome = await self._examine_divergence(res, now_ns)
+                    if outcome == "proven":
                         raise res
+                    if outcome == "unreachable":
+                        # A transient transport blip is NOT proof the
+                        # witness forged its header — keep it and let a
+                        # later cross-check retry (dropping it here
+                        # would suppress genuine attack evidence).
+                        logger.warning(
+                            "witness %d diverged but became unreachable"
+                            " during examination; keeping it", i)
+                        continue
                     logger.warning(
                         "witness %d could not prove its conflicting "
                         "header; removing it", i)
@@ -216,25 +226,34 @@ class Client:
             raise DivergenceError(idx, wb, verified)
 
     async def _examine_divergence(self, div: DivergenceError,
-                                  now_ns: int) -> bool:
+                                  now_ns: int) -> str:
         """Try to verify the witness's conflicting block from the last
         height the witness and our (primary-derived) store agree on.
-        Returns True — after building + submitting attack evidence —
-        when the witness proves a genuine fork; False when the witness
-        fails to prove its header (caller drops it)."""
+        Returns "proven" — after building + submitting attack
+        evidence — when the witness proves a genuine fork;
+        "unprovable" when the witness fails to prove its header
+        (caller drops it); "unreachable" when transport failures made
+        examination impossible (caller keeps the witness — a network
+        blip must not be classified as an unprovable forgery)."""
+        from .provider import ProviderError
+
         witness = self.witnesses[div.witness_index]
         target_h = div.primary_block.height()
-        common = await self._find_common_block(witness, target_h)
+        common, reachable = await self._find_common_block(witness, target_h)
         if common is None:
-            return False
+            return "unprovable" if reachable else "unreachable"
         try:
             await self._verify_skipping(
                 common, div.witness_block, now_ns,
                 provider=witness, persist=False)
+        except ProviderError:
+            return "unreachable"  # pivot fetch failed, not a bad proof
         except (LightClientError, ValueError):
             # ValueError: structural validate_basic failures — the
             # witness's block is not even well-formed.
-            return False
+            return "unprovable"
+        except (OSError, asyncio.TimeoutError):
+            return "unreachable"
         await self._report_attack(common, div, witness)
         # The fork is PROVEN: every primary-derived block above the
         # common height may be the attacker's — including the target
@@ -245,13 +264,16 @@ class Client:
         for h in self.store.heights():
             if h > common.height():
                 self.store.delete(h)
-        return True
+        return "proven"
 
-    async def _find_common_block(self, witness: Provider,
-                                 below: int) -> LightBlock | None:
+    async def _find_common_block(self, witness: Provider, below: int
+                                 ) -> tuple[LightBlock | None, bool]:
         """Latest stored (trusted) block strictly below `below` whose
         hash the witness also reports (reference detector.go walks the
-        primary trace backwards the same way)."""
+        primary trace backwards the same way). Second element is False
+        when EVERY witness fetch failed — total unreachability, which
+        the caller must not confuse with "no common block exists"."""
+        any_response = False
         for h in sorted(self.store.heights(), reverse=True):
             if h >= below:
                 continue
@@ -266,9 +288,10 @@ class Client:
                 # drop an honest witness and suppress the evidence);
                 # keep walking down.
                 continue
+            any_response = True
             if theirs.hash() == ours.hash():
-                return ours
-        return None
+                return ours, True
+        return None, any_response
 
     async def _report_attack(self, common: LightBlock,
                              div: DivergenceError,
@@ -289,7 +312,7 @@ class Client:
                 common_height=common.height(),
                 byzantine_validators=compute_byzantine_validators(
                     common.validator_set,
-                    trusted.signed_header.header,
+                    trusted.signed_header,
                     conflicting,
                 ),
                 total_voting_power=common.validator_set.total_voting_power(),

@@ -62,31 +62,59 @@ class LightBlock:
                 "validator set does not match header validators_hash")
 
     def to_proto(self) -> Writer:
-        w = Writer()
-        w.bytes(1, self.signed_header.header.to_proto().finish(), skip_empty=False)
-        w.bytes(2, self.signed_header.commit.to_bytes(), skip_empty=False)
+        """Wire layout mirrors the reference proto exactly
+        (proto/tendermint/types/types.proto:140 LightBlock:
+        signed_header=1, validator_set=2; validator.proto:9
+        ValidatorSet: validators=1, proposer=2, total_voting_power=3)
+        so evidence bytes and hashes interop with reference-format
+        peers."""
+        sh = Writer()
+        sh.bytes(1, self.signed_header.header.to_proto().finish(),
+                 skip_empty=False)
+        sh.bytes(2, self.signed_header.commit.to_bytes(),
+                 skip_empty=False)
+        vs = Writer()
         for v in self.validator_set.validators:
-            w.bytes(3, v.to_proto().finish(), skip_empty=False)
+            vs.bytes(1, v.to_proto().finish(), skip_empty=False)
         if self.validator_set.proposer is not None:
-            w.bytes(4, self.validator_set.proposer.address)
+            vs.bytes(2, self.validator_set.proposer.to_proto().finish(),
+                     skip_empty=False)
+        vs.varint(3, self.validator_set.total_voting_power())
+        w = Writer()
+        w.message(1, sh)
+        w.message(2, vs)
         return w
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "LightBlock":
         r = Reader(data)
         header = commit = None
-        proposer = b""
+        proposer: Validator | None = None
         vals: list[Validator] = []
         while not r.at_end():
             f, wt = r.field()
             if f == 1:
-                header = Header.from_bytes(r.bytes())
+                sr = Reader(r.bytes())
+                while not sr.at_end():
+                    sf, swt = sr.field()
+                    if sf == 1:
+                        header = Header.from_bytes(sr.bytes())
+                    elif sf == 2:
+                        commit = Commit.from_bytes(sr.bytes())
+                    else:
+                        sr.skip(swt)
             elif f == 2:
-                commit = Commit.from_bytes(r.bytes())
-            elif f == 3:
-                vals.append(Validator.from_bytes(r.bytes()))
-            elif f == 4:
-                proposer = r.bytes()
+                vr = Reader(r.bytes())
+                while not vr.at_end():
+                    vf, vwt = vr.field()
+                    if vf == 1:
+                        vals.append(Validator.from_bytes(vr.bytes()))
+                    elif vf == 2:
+                        proposer = Validator.from_bytes(vr.bytes())
+                    elif vf == 3:
+                        vr.varint()  # total_voting_power: recomputed
+                    else:
+                        vr.skip(vwt)
             else:
                 r.skip(wt)
         if header is None or commit is None:
@@ -96,9 +124,9 @@ class LightBlock:
         # which would change the wire bytes and thus the evidence hash.
         vs = ValidatorSet([])
         vs.validators = vals
-        if proposer:
-            _, vp = vs.get_by_address(proposer)
-            vs.proposer = vp
+        if proposer is not None:
+            _, vp = vs.get_by_address(proposer.address)
+            vs.proposer = vp if vp is not None else proposer
         return cls(SignedHeader(header, commit), vs)
 
 
@@ -117,38 +145,52 @@ def conflicting_header_is_invalid(conflicting: Header, trusted: Header) -> bool:
 
 
 def compute_byzantine_validators(common_vals: ValidatorSet,
-                                 trusted_header: Header,
+                                 trusted: "SignedHeader",
                                  conflicting_block: "LightBlock"
                                  ) -> list[Validator]:
     """The punishable signer set for an attack, deterministically
     derived so the detector and every verifying full node agree
-    (reference: types/evidence.go GetByzantineValidators):
+    (reference: types/evidence.go:253-280 GetByzantineValidators):
 
     - LUNATIC (conflicting header is invalid w.r.t. the trusted one):
       validators of the COMMON valset that signed the conflicting
       commit — they signed off a header the chain could never produce.
-    - EQUIVOCATION (same height, header otherwise valid): signers of
-      the conflicting commit present in the conflicting block's own
-      valset — they double-signed at that height.
-    - AMNESIA (different height, header valid): indeterminable from
-      the evidence alone; empty list.
+    - EQUIVOCATION (commit ROUNDS equal, header otherwise valid):
+      validators that voted in BOTH commits — only signing both is
+      double-signing; a validator that precommitted only the
+      conflicting block may have done so legitimately. The valsets
+      are identical (validators_hash matches), so the commits are
+      index-aligned and one indexed pass suffices.
+    - AMNESIA (rounds differ, header valid): indeterminable from the
+      evidence alone; empty list.
+
+    Ordered by voting power (desc, address tiebreak), matching the
+    reference's ValidatorsByVotingPower sort.
     """
     commit = conflicting_block.signed_header.commit
     ch = conflicting_block.signed_header.header
-    if conflicting_header_is_invalid(ch, trusted_header):
-        source = common_vals
-    elif ch.height == trusted_header.height:
-        source = conflicting_block.validator_set
+    out: list[Validator] = []
+    if conflicting_header_is_invalid(ch, trusted.header):
+        for cs in commit.signatures:
+            if not cs.for_block():
+                continue
+            _, val = common_vals.get_by_address(cs.validator_address)
+            if val is not None:
+                out.append(val.copy())
+    elif trusted.commit.round == commit.round:
+        trusted_sigs = trusted.commit.signatures
+        for i, sig_a in enumerate(commit.signatures):
+            if sig_a.is_absent() or i >= len(trusted_sigs):
+                continue
+            if trusted_sigs[i].is_absent():
+                continue
+            _, val = conflicting_block.validator_set.get_by_address(
+                sig_a.validator_address)
+            if val is not None:
+                out.append(val.copy())
     else:
         return []
-    out = []
-    for cs in commit.signatures:
-        if not cs.for_block():
-            continue
-        _, val = source.get_by_address(cs.validator_address)
-        if val is not None:
-            out.append(val.copy())
-    out.sort(key=lambda v: v.address)
+    out.sort(key=lambda v: (-v.voting_power, v.address))
     return out
 
 

@@ -24,6 +24,7 @@ from ..consensus.wal import WAL
 from ..evidence import Pool as EvidencePool
 from ..evidence.reactor import EvidenceReactor
 from ..libs.db import DB, FileDB, MemDB
+from ..libs.net import split_laddr as _split_laddr
 from ..libs.service import Service
 from ..mempool.clist_mempool import CListMempool
 from ..mempool.reactor import MempoolReactor
@@ -65,9 +66,10 @@ def default_app_creator(config: Config):
         raise ValueError(f"unknown builtin app {name!r}")
     if name.startswith("unix://"):
         return ClientCreator(unix_path=name[len("unix://"):])
-    addr = name[len("tcp://"):] if name.startswith("tcp://") else name
-    host, _, port = addr.rpartition(":")
-    return ClientCreator(addr=(host or "127.0.0.1", int(port)))
+    host, port = _split_laddr(name, default_host="127.0.0.1")
+    if config.base.abci == "grpc":
+        return ClientCreator(grpc_addr=(host, port))
+    return ClientCreator(addr=(host, port))
 
 
 def _db(config: Config, name: str, in_memory: bool) -> DB:
@@ -76,12 +78,6 @@ def _db(config: Config, name: str, in_memory: bool) -> DB:
     d = config.base.resolve(config.base.db_dir)
     os.makedirs(d, exist_ok=True)
     return FileDB(os.path.join(d, f"{name}.db"))
-
-
-def _split_laddr(laddr: str) -> tuple[str, int]:
-    addr = laddr[len("tcp://"):] if laddr.startswith("tcp://") else laddr
-    host, _, port = addr.rpartition(":")
-    return host or "0.0.0.0", int(port)
 
 
 class Node(Service):
@@ -229,6 +225,15 @@ class Node(Service):
             rhost, rport = _split_laddr(cfg.rpc.laddr)
             self.rpc_server, self.rpc_port = await serve(
                 self.rpc_env(), rhost, rport)
+        self.grpc_server = None
+        if cfg.rpc.grpc_laddr:
+            from ..rpc.grpc_api import GRPCBroadcastServer
+
+            ghost, gport = _split_laddr(cfg.rpc.grpc_laddr)
+            self.grpc_server = GRPCBroadcastServer(
+                self.rpc_env(), ghost, gport)
+            await self.grpc_server.start()
+            self.grpc_port = self.grpc_server.port
         # pprof + Prometheus listeners (reference node.go:807-812,
         # :873; config rpc.pprof_laddr / instrumentation.prometheus)
         self.debug_server = None
@@ -300,6 +305,8 @@ class Node(Service):
     async def on_stop(self) -> None:
         if self.rpc_server is not None:
             self.rpc_server.close()
+        if getattr(self, "grpc_server", None) is not None:
+            await self.grpc_server.stop()
         if getattr(self, "debug_server", None) is not None:
             self.debug_server.close()
         if getattr(self, "prometheus_server", None) is not None:

@@ -1,0 +1,179 @@
+"""ABCI gRPC transport + abci-cli golden protocol tests + gRPC
+broadcast API (reference: abci/client/grpc_client.go,
+abci/server/grpc_server.go, abci/tests/test_cli, rpc/grpc/grpc.go)."""
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tendermint_tpu.abci import types as t
+from tendermint_tpu.abci.grpc_client import GRPCClient
+from tendermint_tpu.abci.grpc_server import GRPCServer
+from tendermint_tpu.abci.client import ABCIClientError
+from tendermint_tpu.abci.kvstore import KVStoreApp, PersistentKVStoreApp
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_grpc_client_server_roundtrip():
+    async def go():
+        server = GRPCServer(KVStoreApp(), port=0)
+        await server.start()
+        client = GRPCClient("127.0.0.1", server.port)
+        await client.start()
+        try:
+            assert (await client.echo("hi")).message == "hi"
+            await client.flush()
+            info = await client.info(t.RequestInfo())
+            assert info.last_block_height == 0
+            res = await client.deliver_tx(t.RequestDeliverTx(b"a=1"))
+            assert res.code == t.CODE_TYPE_OK
+            commit = await client.commit()
+            assert commit.data == (0).to_bytes(7, "big") + b"\x01"
+            q = await client.query(t.RequestQuery(data=b"a"))
+            assert q.value == b"1" and q.log == "exists"
+            # pipelined submits resolve independently
+            tasks = [client.submit(t.RequestDeliverTx(b"k%d" % i))
+                     for i in range(16)]
+            out = await asyncio.gather(*tasks)
+            assert all(r.code == t.CODE_TYPE_OK for r in out)
+        finally:
+            await client.stop()
+            await server.stop()
+
+    run(go())
+
+
+def test_grpc_app_errors_are_rpc_errors_not_dead_server():
+    class Boom(KVStoreApp):
+        def query(self, req):
+            raise RuntimeError("boom")
+
+    async def go():
+        server = GRPCServer(Boom(), port=0)
+        await server.start()
+        client = GRPCClient("127.0.0.1", server.port)
+        await client.start()
+        try:
+            with pytest.raises(ABCIClientError, match="boom"):
+                await client.query(t.RequestQuery(data=b"x"))
+            # server survives; next call works
+            assert (await client.echo("still up")).message == "still up"
+        finally:
+            await client.stop()
+            await server.stop()
+
+    run(go())
+
+
+@pytest.mark.parametrize("transport", ["socket", "grpc"])
+def test_abci_cli_golden(transport, tmp_path):
+    """The reference's abci/tests/test_cli flow: run the kvstore app
+    server, pipe the golden script through `abci-cli batch`, diff the
+    output — on BOTH transports (they must be indistinguishable above
+    the framing)."""
+    port = 29358 if transport == "socket" else 29359
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    srv = subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.abci.cli", "kvstore",
+         "--address", f"tcp://127.0.0.1:{port}", "--abci", transport],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if srv.stdout.readline().startswith(b"serving"):
+                break
+        script = open(os.path.join(GOLDEN_DIR, "ex1.abci"), "rb").read()
+        out = subprocess.run(
+            [sys.executable, "-m", "tendermint_tpu.abci.cli", "batch",
+             "--address", f"tcp://127.0.0.1:{port}", "--abci", transport],
+            input=script, capture_output=True, env=env, timeout=60)
+        assert out.returncode == 0, out.stderr
+        golden = open(os.path.join(GOLDEN_DIR, "ex1.abci.out"), "rb").read()
+        assert out.stdout.decode() == golden.decode()
+    finally:
+        srv.terminate()
+        srv.wait(10)
+
+
+def test_node_runs_against_grpc_app(tmp_path):
+    """A full node drives a gRPC-connected out-of-process-style app
+    through all 4 proxy connections (consensus/mempool/query/snapshot
+    all ride the same gRPC server here)."""
+    from test_node import make_home, single_val_genesis
+    from tendermint_tpu.node import Node
+
+    async def go():
+        app = PersistentKVStoreApp()
+        appsrv = GRPCServer(app, port=0)
+        await appsrv.start()
+
+        gdoc, pvs = single_val_genesis()
+        cfg = make_home(tmp_path, "n0", gdoc)
+        cfg.base.abci = "grpc"
+        cfg.base.proxy_app = f"tcp://127.0.0.1:{appsrv.port}"
+        pv = pvs[0]
+        pv.key_path = cfg.base.resolve(cfg.base.priv_validator_key_file)
+        pv.state_path = cfg.base.resolve(cfg.base.priv_validator_state_file)
+        pv.save_key()
+
+        node = Node.default_new_node(cfg)
+        await node.start()
+        try:
+            await node.consensus_state.wait_for_height(3, timeout=60)
+            tx = b"grpc-test=yes"
+            res = await node.mempool.check_tx(tx)
+            assert res.code == t.CODE_TYPE_OK
+            deadline = time.monotonic() + 30
+            while app.db.get(b"kv:grpc-test") is None:
+                assert time.monotonic() < deadline, "tx never delivered"
+                await asyncio.sleep(0.2)
+            assert app.db.get(b"kv:grpc-test") == b"yes"
+            assert app.height >= 3
+        finally:
+            await node.stop()
+            await appsrv.stop()
+
+    run(go())
+
+
+def test_grpc_broadcast_api(tmp_path):
+    """reference rpc/grpc: Ping + BroadcastTx(commit semantics)."""
+    from test_node import make_home, single_val_genesis
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.rpc.grpc_api import GRPCBroadcastClient
+
+    async def go():
+        gdoc, pvs = single_val_genesis()
+        cfg = make_home(tmp_path, "n0", gdoc)
+        cfg.rpc.grpc_laddr = "tcp://127.0.0.1:0"
+        pv = pvs[0]
+        pv.key_path = cfg.base.resolve(cfg.base.priv_validator_key_file)
+        pv.state_path = cfg.base.resolve(cfg.base.priv_validator_state_file)
+        pv.save_key()
+
+        node = Node.default_new_node(cfg)
+        await node.start()
+        try:
+            await node.consensus_state.wait_for_height(2, timeout=60)
+            cli = GRPCBroadcastClient("127.0.0.1", node.grpc_port)
+            assert await cli.ping() == {}
+            res = await cli.broadcast_tx(b"gk=gv")
+            assert res["check_tx"].get("code", 0) == 0
+            assert res["deliver_tx"].get("code", 0) == 0
+            await cli.close()
+        finally:
+            await node.stop()
+
+    run(go())

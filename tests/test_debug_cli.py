@@ -1,0 +1,130 @@
+"""`tendermint-tpu debug kill|dump` against a live subprocess node
+(reference: cmd/tendermint/commands/debug/{kill,dump}.go)."""
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import tarfile
+import time
+
+from tendermint_tpu.cmd import main
+
+RPC_PORT = 28957
+P2P_PORT = 28956
+PPROF_PORT = 28958
+
+
+def _boot_node(tmp_path):
+    home = str(tmp_path / "home")
+    assert main(["--home", home, "init", "--chain-id", "debug-chain"]) == 0
+    cfg_path = os.path.join(home, "config", "config.toml")
+    cfg = open(cfg_path).read()
+    cfg = cfg.replace('laddr = "tcp://127.0.0.1:26657"',
+                      f'laddr = "tcp://127.0.0.1:{RPC_PORT}"')
+    cfg = cfg.replace('laddr = "tcp://0.0.0.0:26656"',
+                      f'laddr = "tcp://127.0.0.1:{P2P_PORT}"')
+    cfg = cfg.replace('pprof_laddr = ""',
+                      f'pprof_laddr = "tcp://127.0.0.1:{PPROF_PORT}"')
+    cfg = cfg.replace("fast_sync = true", "fast_sync = false")
+    cfg = cfg.replace("timeout_commit_ms = 1000", "timeout_commit_ms = 50")
+    open(cfg_path, "w").write(cfg)
+
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.cmd", "--home", home,
+         "start"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+    return home, proc
+
+
+async def _wait_for_height(h: int, timeout: float = 60.0):
+    from tendermint_tpu.rpc.jsonrpc import HTTPClient
+
+    cli = HTTPClient("127.0.0.1", RPC_PORT, timeout=5)
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            st = await cli.call("status")
+            if int(st["sync_info"]["latest_block_height"]) >= h:
+                return
+        except Exception:
+            if time.monotonic() > deadline:
+                raise
+        await asyncio.sleep(0.5)
+
+
+def test_debug_dump_and_kill(tmp_path, capsys):
+    home, proc = _boot_node(tmp_path)
+    out_dir = str(tmp_path / "bundles")
+    try:
+        asyncio.run(_wait_for_height(2))
+
+        # -- dump: one bundle with every artifact --
+        assert main([
+            "debug", "dump", out_dir, "--count", "1",
+            "--home", home,
+            "--rpc-laddr", f"127.0.0.1:{RPC_PORT}",
+            "--pprof-laddr", f"127.0.0.1:{PPROF_PORT}",
+        ]) == 0
+        bundles = sorted(os.listdir(out_dir))
+        assert len(bundles) == 1 and bundles[0].endswith(".tar.gz")
+        with tarfile.open(os.path.join(out_dir, bundles[0])) as tar:
+            names = tar.getnames()
+            for want in ("status.json", "net_info.json",
+                         "consensus_state.json", "goroutine.txt",
+                         "heap.txt", "config.toml"):
+                assert want in names, f"{want} missing from {names}"
+            assert "INCOMPLETE.txt" not in names, \
+                tar.extractfile("INCOMPLETE.txt").read()
+            assert any(n.startswith("cs.wal") for n in names), names
+            st = tar.extractfile("status.json").read()
+            assert b"debug-chain" in st
+            gr = tar.extractfile("goroutine.txt").read()
+            assert b"asyncio tasks" in gr
+
+        # -- kill: bundle + SIGABRT terminates the node --
+        kill_out = str(tmp_path / "kill.tar.gz")
+        assert main([
+            "debug", "kill", str(proc.pid), kill_out,
+            "--home", home,
+            "--rpc-laddr", f"127.0.0.1:{RPC_PORT}",
+            "--pprof-laddr", f"127.0.0.1:{PPROF_PORT}",
+        ]) == 0
+        assert os.path.exists(kill_out)
+        with tarfile.open(kill_out) as tar:
+            assert "consensus_state.json" in tar.getnames()
+        rc = proc.wait(timeout=15)
+        assert rc != 0  # SIGABRT, not a clean exit
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(10)
+
+
+def test_debug_kill_missing_process(tmp_path):
+    """Collection is best-effort: unreachable node + dead pid still
+    produces a bundle (flagged INCOMPLETE) and a nonzero exit."""
+    out = str(tmp_path / "b.tar.gz")
+    # Find an unused pid.
+    pid = 2 ** 22 - 3
+    while True:
+        try:
+            os.kill(pid, 0)
+            pid -= 1
+        except ProcessLookupError:
+            break
+        except PermissionError:
+            pid -= 1
+    assert main([
+        "debug", "kill", str(pid), out,
+        "--home", str(tmp_path / "nohome"),
+        "--rpc-laddr", "127.0.0.1:1",  # nothing listens
+        "--pprof-laddr", "127.0.0.1:1",
+    ]) == 1
+    with tarfile.open(out) as tar:
+        assert "INCOMPLETE.txt" in tar.getnames()

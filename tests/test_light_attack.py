@@ -36,52 +36,68 @@ def run(coro):
 
 
 class _Ctx:
-    """Committed chain: block 1 in the store + valset saved (the same
-    shape test_evidence.py uses)."""
+    """Committed chain: blocks 1 and 2 in the store + valsets saved.
+    Two heights so LUNATIC evidence can anchor at a common height
+    strictly BELOW the conflicting height (the reference rejects
+    same-height lunatic headers, evidence/verify.go:135-139)."""
 
     def __init__(self):
         self.state, self.pvs = make_genesis_state_and_pvs(4)
         vals = self.state.validators
         self.state_store = Store(MemDB())
         self.block_store = BlockStore(MemDB())
-        block = self.state.make_block(1, [], None, [],
-                                      vals.get_proposer().address,
-                                      GENESIS_TIME + 10)
-        parts = block.make_part_set()
-        bid = BlockID(block.hash(), parts.header())
-        commit = sign_commit(vals, self.pvs, self.state.chain_id, 1, 0,
-                             bid, GENESIS_TIME + 11)
-        self.block_store.save_block(block, parts, commit)
-        self.state_store.save_validator_set(1, vals)
-        self.block_time = block.header.time
-        st = self.state.copy()
-        st.last_block_height = 1
-        st.last_block_time = self.block_time
+        st = self.state
+        prev_commit = None
+        for h in (1, 2):
+            block = st.make_block(h, [], prev_commit, [],
+                                  vals.get_proposer().address,
+                                  GENESIS_TIME + 10 * h)
+            parts = block.make_part_set()
+            bid = BlockID(block.hash(), parts.header())
+            prev_commit = sign_commit(vals, self.pvs, st.chain_id, h, 0,
+                                      bid, GENESIS_TIME + 10 * h + 1)
+            self.block_store.save_block(block, parts, prev_commit)
+            self.state_store.save_validator_set(h, vals)
+            st = st.copy()
+            st.last_block_height = h
+            st.last_block_id = bid
+            st.last_block_time = block.header.time
+        self.block_time = self.block_store.load_block_meta(1).header.time
         self.committed_state = st
         self.state_store.save(st)
 
 
-def _conflicting_block(ctx, pvs=None, **header_changes) -> LightBlock:
-    """A block-1 variant re-signed by (by default) the real validators —
-    a genuine attack artifact."""
-    real = ctx.block_store.load_block_meta(1).header
+def _conflicting_block(ctx, height: int = 2, round_: int = 0, pvs=None,
+                       **header_changes) -> LightBlock:
+    """A committed-block variant re-signed by (by default) the real
+    validators — a genuine attack artifact."""
+    real = ctx.block_store.load_block_meta(height).header
     forged = dataclasses.replace(real, **header_changes)
     bid = BlockID(forged.hash(), PartSetHeader(1, b"\x07" * 32))
     commit = sign_commit(ctx.state.validators, pvs or ctx.pvs,
-                         ctx.state.chain_id, 1, 0, bid, real.time + 1)
+                         ctx.state.chain_id, height, round_, bid,
+                         real.time + 1)
     return LightBlock(SignedHeader(forged, commit), ctx.state.validators)
 
 
-def _attack_evidence(ctx, cb: LightBlock) -> LightClientAttackEvidence:
-    trusted = ctx.block_store.load_block_meta(cb.height()).header
-    common_vals = ctx.state_store.load_validators(1)
+def _trusted_sh(block_store, height: int) -> SignedHeader:
+    meta = block_store.load_block_meta(height)
+    commit = block_store.load_block_commit(height) or \
+        block_store.load_seen_commit(height)
+    return SignedHeader(meta.header, commit)
+
+
+def _attack_evidence(ctx, cb: LightBlock,
+                     common_height: int = 1) -> LightClientAttackEvidence:
+    trusted = _trusted_sh(ctx.block_store, cb.height())
+    common_vals = ctx.state_store.load_validators(common_height)
     return LightClientAttackEvidence(
         conflicting_block=cb,
-        common_height=1,
+        common_height=common_height,
         byzantine_validators=compute_byzantine_validators(
             common_vals, trusted, cb),
         total_voting_power=common_vals.total_voting_power(),
-        timestamp=ctx.block_time,
+        timestamp=ctx.block_store.load_block_meta(common_height).header.time,
     )
 
 
@@ -102,16 +118,56 @@ def test_codec_roundtrip():
 
 def test_verify_accepts_valid_attack():
     ctx = _Ctx()
-    # Lunatic flavor: forged app hash, signed by the real validators.
+    # Lunatic flavor: forged app hash at height 2, anchored at common
+    # height 1, signed by the real validators.
     ev = _attack_evidence(ctx, _conflicting_block(ctx,
                                                   app_hash=b"\xee" * 32))
     assert len(ev.byzantine_validators) == 4
     verify_evidence(ev, ctx.committed_state, ctx.state_store,
                     ctx.block_store)
-    # Equivocation flavor: only the data hash differs.
-    ev2 = _attack_evidence(ctx, _conflicting_block(ctx,
-                                                   data_hash=b"\xdd" * 32))
+    # Equivocation flavor: same height/round, only the data hash
+    # differs; signers of BOTH commits are byzantine.
+    ev2 = _attack_evidence(ctx,
+                           _conflicting_block(ctx, data_hash=b"\xdd" * 32),
+                           common_height=2)
+    assert len(ev2.byzantine_validators) == 4
     verify_evidence(ev2, ctx.committed_state, ctx.state_store,
+                    ctx.block_store)
+
+
+def test_amnesia_evidence_has_empty_byzantine_set():
+    """A correctly-derived conflicting header whose commit is from a
+    DIFFERENT round than the trusted commit is an amnesia attack: no
+    validator is provably byzantine from the evidence alone, and the
+    empty set must still verify (reference types/evidence.go:273-280,
+    evidence/verify.go accepts a nil set)."""
+    ctx = _Ctx()
+    cb = _conflicting_block(ctx, round_=1, data_hash=b"\xdd" * 32)
+    ev = _attack_evidence(ctx, cb, common_height=2)
+    assert ev.byzantine_validators == []
+    verify_evidence(ev, ctx.committed_state, ctx.state_store,
+                    ctx.block_store)
+    # ...but a non-empty CLAIMED set on amnesia evidence is rejected.
+    bad = dataclasses.replace(
+        ev, byzantine_validators=list(ctx.state.validators.validators))
+    with pytest.raises(EvidenceError, match="byzantine"):
+        verify_evidence(bad, ctx.committed_state, ctx.state_store,
+                        ctx.block_store)
+
+
+def test_equivocation_requires_signers_of_both_commits():
+    """Only validators that signed BOTH the trusted and the conflicting
+    commit are byzantine: a validator absent from the conflicting
+    commit may have behaved legitimately (ADVICE r2 high finding;
+    reference types/evidence.go:253-271)."""
+    ctx = _Ctx()
+    # Conflicting commit signed by only 3 of the 4 validators.
+    cb = _conflicting_block(ctx, pvs=ctx.pvs[:3], data_hash=b"\xdd" * 32)
+    ev = _attack_evidence(ctx, cb, common_height=2)
+    signed_addrs = {pv.get_pub_key().address() for pv in ctx.pvs[:3]}
+    assert len(ev.byzantine_validators) == 3
+    assert {v.address for v in ev.byzantine_validators} == signed_addrs
+    verify_evidence(ev, ctx.committed_state, ctx.state_store,
                     ctx.block_store)
 
 
@@ -120,14 +176,19 @@ def test_verify_rejections():
     cb = _conflicting_block(ctx, app_hash=b"\xee" * 32)
 
     # 1. "conflicting" block that matches the chain
-    real_meta = ctx.block_store.load_block_meta(1)
-    real_commit = ctx.block_store.load_block_commit(1) or \
-        ctx.block_store.load_seen_commit(1)
-    honest = LightBlock(SignedHeader(real_meta.header, real_commit),
-                        ctx.state.validators)
+    real = _trusted_sh(ctx.block_store, 2)
+    honest = LightBlock(real, ctx.state.validators)
     ev = _attack_evidence(ctx, cb)
     ev = dataclasses.replace(ev, conflicting_block=honest)
     with pytest.raises(EvidenceError, match="matches the committed"):
+        verify_evidence(ev, ctx.committed_state, ctx.state_store,
+                        ctx.block_store)
+
+    # 1b. lunatic header at the SAME height as the common height is
+    # nonsense — must be anchored strictly below (ADVICE r2 low;
+    # reference evidence/verify.go:135-139).
+    ev = _attack_evidence(ctx, cb, common_height=2)
+    with pytest.raises(EvidenceError, match="correctly derived"):
         verify_evidence(ev, ctx.committed_state, ctx.state_store,
                         ctx.block_store)
 
@@ -279,16 +340,17 @@ def test_attack_evidence_lands_in_block_on_live_net():
             n0 = nodes[0]
             await asyncio.gather(
                 *(n.cs.wait_for_height(2, timeout=60) for n in nodes))
-            # Forge a conflicting block 1 signed by the real validators
-            # (the attack artifact a light client would extract), and
-            # hand the evidence to node 0 as the detector would via
+            # Forge a conflicting block 2 signed by the real validators
+            # (the attack artifact a light client would extract — a
+            # lunatic header anchored at common height 1), and hand the
+            # evidence to node 0 as the detector would via
             # report_evidence -> broadcast_evidence -> evpool.
-            meta = n0.block_store.load_block_meta(1)
+            meta = n0.block_store.load_block_meta(2)
             vals = n0.cs.state.validators
             pvs = [n.pv for n in nodes]
             forged = dataclasses.replace(meta.header, app_hash=b"\xee" * 32)
             bid = BlockID(forged.hash(), PartSetHeader(1, b"\x07" * 32))
-            commit = sign_commit(vals, pvs, n0.gdoc.chain_id, 1, 0, bid,
+            commit = sign_commit(vals, pvs, n0.gdoc.chain_id, 2, 0, bid,
                                  meta.header.time + 1)
             cb = LightBlock(SignedHeader(forged, commit), vals)
             common_vals = n0.state_store.load_validators(1)
@@ -296,9 +358,9 @@ def test_attack_evidence_lands_in_block_on_live_net():
                 conflicting_block=cb,
                 common_height=1,
                 byzantine_validators=compute_byzantine_validators(
-                    common_vals, meta.header, cb),
+                    common_vals, _trusted_sh(n0.block_store, 2), cb),
                 total_voting_power=common_vals.total_voting_power(),
-                timestamp=meta.header.time,
+                timestamp=n0.block_store.load_block_meta(1).header.time,
             )
             n0.evpool.add_evidence(ev)
             assert n0.evpool.size() == 1

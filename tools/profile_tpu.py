@@ -1,0 +1,84 @@
+"""Stage-by-stage timing of the expanded-path verify on the real chip.
+
+Prints one line per stage so a hang/timeout points at the guilty stage.
+Usage: python tools/profile_tpu.py [n_keys] [n_lanes]
+"""
+
+import hashlib
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+T0 = time.perf_counter()
+
+
+def log(msg):
+    print(f"[{time.perf_counter() - T0:8.2f}s] {msg}", flush=True)
+
+
+def main():
+    n_keys = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    n_lanes = int(sys.argv[2]) if len(sys.argv) > 2 else n_keys
+
+    log("importing jax...")
+    import jax
+
+    log(f"devices: {jax.devices()}")
+
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    keys = [
+        Ed25519PrivateKey.from_private_bytes(
+            hashlib.sha256(b"bench%d" % i).digest())
+        for i in range(n_keys)
+    ]
+    pubs = [
+        k.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+        for k in keys
+    ]
+    msgs = [b"precommit h=1234 r=0 block=deadbeef val=%d" % i
+            for i in range(n_lanes)]
+    sigs = [keys[i % n_keys].sign(m) for i, m in enumerate(msgs)]
+    idx = [i % n_keys for i in range(n_lanes)]
+    log(f"made {n_keys} keys / {n_lanes} lanes")
+
+    from tendermint_tpu.crypto.tpu import expanded as ex
+
+    t = time.perf_counter()
+    exp = ex.ExpandedKeys(pubs)
+    log(f"table build call returned in {time.perf_counter() - t:.2f}s "
+        "(async dispatch)")
+    t = time.perf_counter()
+    exp.tables.block_until_ready()
+    log(f"table build synced in {time.perf_counter() - t:.2f}s; "
+        f"shape {exp.tables.shape} "
+        f"({exp.tables.size * 4 / 2**30:.2f} GiB)")
+
+    t = time.perf_counter()
+    out = exp.verify(idx, msgs, sigs)
+    log(f"first verify (compile+run) {time.perf_counter() - t:.2f}s; "
+        f"all={bool(out.all())}")
+
+    for i in range(3):
+        t = time.perf_counter()
+        out = exp.verify(idx, msgs, sigs)
+        log(f"warm verify #{i} {1e3 * (time.perf_counter() - t):.1f}ms")
+
+    t = time.perf_counter()
+    pidx, packed, _ = exp._prepare(idx, msgs, sigs)
+    log(f"host prepare {1e3 * (time.perf_counter() - t):.1f}ms")
+    for i in range(3):
+        t = time.perf_counter()
+        o = exp._launch(pidx, packed)
+        o.block_until_ready()
+        log(f"device launch #{i} {1e3 * (time.perf_counter() - t):.1f}ms")
+
+
+if __name__ == "__main__":
+    main()
