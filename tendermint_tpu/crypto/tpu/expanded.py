@@ -192,6 +192,21 @@ class ExpandedKeys:
         a_raw = np.frombuffer(b"".join(self.pubkeys), np.uint8).reshape(-1, 32)
         self._a_raw = a_raw
         tables, ok = _builder()(jnp.asarray(a_raw))
+        # Multi-chip: REPLICATE the tables over the ('dp',) mesh and
+        # shard lanes at launch (same scheme as verify_batch). Lane
+        # digits address arbitrary table rows, so a row-sharded table
+        # would turn the flat gather into an all-gather of the full
+        # multi-GB buffer every launch; replication keeps every gather
+        # chip-local at 69 * 512 B/lane. HBM cost is the table size per
+        # chip (~318 KB/key, 3.3 GB at 10k keys — within a v5e's 16 GB;
+        # beyond ~40k keys switch to key-range sharding + lane routing).
+        mesh = tv._mesh()
+        if mesh is not None:
+            import jax
+
+            _, _, repl_s = tv._shardings(mesh)
+            tables = jax.device_put(tables, repl_s)
+            ok = jax.device_put(ok, repl_s)
         self.tables = tables  # keep on device
         self.key_ok = ok
 
@@ -236,12 +251,29 @@ class ExpandedKeys:
         return idx, packed, well_formed
 
     def _launch(self, idx, packed):
-        """Device side of verify: one kernel launch over packed lanes."""
+        """Device side of verify: one kernel launch over packed lanes,
+        lane-sharded over the ('dp',) mesh when one exists (tables and
+        comb constants replicated; verdict gather is the only
+        cross-chip traffic)."""
+        btab = tv.b_comb_tables()
+        mesh = tv._mesh()
+        bucket = idx.shape[0]
+        if (mesh is not None and bucket >= tv._SHARD_MIN
+                and bucket % mesh.devices.size == 0):
+            import jax
+
+            row_s, vec_s, repl_s = tv._shardings(mesh)
+            idx = jax.device_put(idx, vec_s)
+            packed = {
+                k: jax.device_put(v, vec_s if v.ndim == 1 else row_s)
+                for k, v in packed.items()
+            }
+            btab = jax.device_put(btab, repl_s)
         return _xkernel()(
             idx=idx,
             key_ok=self.key_ok,
             atab=self.tables,
-            btab=tv.b_comb_tables(),
+            btab=btab,
             **packed,
         )
 

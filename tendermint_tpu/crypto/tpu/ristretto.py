@@ -1,0 +1,81 @@
+"""Batched ristretto255 (RFC 9496) on device: decode + equality.
+
+Built on the same 22-limb field arithmetic as the ed25519 kernel
+(field.py); decode costs one sqrt-ratio exponentiation per lane — the
+same pow_2_252_m3 chain edwards.decompress uses (2^252-3 == (p-5)/8).
+Encoding never runs on device: sr25519 verification only needs
+"encode(V) == R_bytes", which over the quotient group is ristretto
+EQUALITY of V and decode(R_bytes) — checked torsion-exhaustively
+against the host oracle in tests/test_sr25519.py:
+    eq(P1, P2) := x1*y2 == y1*x2  or  y1*y2 == x1*x2.
+
+Host-side preconditions (canonical s < p, non-negative s) are byte
+checks the caller performs in numpy; lanes failing them are gated via
+the `pre_ok` mask.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import edwards as ed
+from . import field as fe
+
+
+def _abs(x: jnp.ndarray) -> jnp.ndarray:
+    """|x|: negate when the canonical representative is odd."""
+    return jnp.where((fe.parity(x) == 1)[None, :], fe.neg(x), x)
+
+
+def sqrt_ratio_m1(u: jnp.ndarray, v: jnp.ndarray,
+                  n: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """RFC 9496 §4.2 SQRT_RATIO_M1 over (22, N) limb vectors.
+
+    Returns (was_square (N,) bool, non-negative root r (22, N))."""
+    v3 = fe.mul(fe.sqr(v), v)
+    v7 = fe.mul(fe.sqr(v3), v)
+    r = fe.mul(fe.mul(u, v3), fe.pow_2_252_m3(fe.mul(u, v7)))
+    check = fe.mul(v, fe.sqr(r))
+    neg_u = fe.neg(u)
+    correct = fe.eq(check, u)
+    flipped = fe.eq(check, neg_u)
+    flipped_i = fe.eq(check, fe.mul(neg_u, fe.splat(fe.SQRT_M1, n)))
+    r = jnp.where((flipped | flipped_i)[None, :],
+                  fe.mul(r, fe.splat(fe.SQRT_M1, n)), r)
+    return correct | flipped, _abs(r)
+
+
+def decode(s: jnp.ndarray, pre_ok: jnp.ndarray) -> tuple[ed.Point, jnp.ndarray]:
+    """RFC 9496 §4.3.1 DECODE of (22, N) limb-unpacked encodings.
+
+    `pre_ok` carries the host byte checks (canonical < p, even). Lanes
+    that fail any check come back as the identity with ok=False so
+    downstream point math stays well-defined."""
+    n = s.shape[-1]
+    one = fe.splat(1, n)
+    ss = fe.sqr(s)
+    u1 = fe.sub(one, ss)
+    u2 = fe.add(one, ss)
+    u2s = fe.sqr(u2)
+    # v = -(D * u1^2) - u2^2
+    v = fe.sub(fe.neg(fe.mul(fe.splat(fe.D, n), fe.sqr(u1))), u2s)
+    was_square, invsqrt = sqrt_ratio_m1(one, fe.mul(v, u2s), n)
+    den_x = fe.mul(invsqrt, u2)
+    den_y = fe.mul(fe.mul(invsqrt, den_x), v)
+    x = _abs(fe.mul(fe.mul(fe.splat(2, n), s), den_x))
+    y = fe.mul(u1, den_y)
+    t = fe.mul(x, y)
+    ok = (was_square
+          & (fe.parity(t) == 0)
+          & ~fe.is_zero(y)
+          & jnp.asarray(pre_ok))
+    x = jnp.where(ok[None, :], x, fe.splat(0, n))
+    y = jnp.where(ok[None, :], y, one)
+    return ed.Point(x, y, one, fe.mul(x, y)), ok
+
+
+def equal(p: ed.Point, q: ed.Point) -> jnp.ndarray:
+    """Ristretto equality (projective; no encode needed):
+    X1*Y2 == Y1*X2  or  Y1*Y2 == X1*X2."""
+    return (fe.eq(fe.mul(p.x, q.y), fe.mul(p.y, q.x))
+            | fe.eq(fe.mul(p.y, q.y), fe.mul(p.x, q.x)))

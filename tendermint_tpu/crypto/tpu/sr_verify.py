@@ -1,0 +1,187 @@
+"""Batched sr25519 (schnorrkel) verification: Merlin on host (SIMD,
+crypto/merlin_batch.py), the group equation on device.
+
+Per lane, schnorrkel verify accepts iff
+    encode([s]B - [k]A) == R_bytes
+with k the Merlin transcript challenge (host) and encode the ristretto
+encoding. Over the quotient group that is ristretto-EQUALITY of
+V = [s]B + [k](-A) and decode(R_bytes), so the kernel never encodes:
+decode A and R (ristretto.py), then one fused 64-window loop — [k](-A)
+via per-lane 4-bit Straus windows, [s]B via the shared fixed-base comb
+(the SAME btab the ed25519 kernel uses; windows 64..68 of its 69 are
+identity rows and are simply not iterated here, k and s both < L <
+2^253 = 64 nibbles).
+
+Semantics match sr25519_ref.verify bit-for-bit (tested on schnorrkel-
+anchored keys, torsioned/corrupted lanes, non-canonical encodings).
+Reference surface: crypto/sr25519/pubkey.go:34-61 (BASELINE config #4:
+mixed ed25519+sr25519 evidence batches).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .. import ed25519_ref as ref
+from . import verify as tv
+
+_L = ref.L
+_P = ref.P
+_WINDOWS = 64  # k, s < L < 2^253: 64 nibbles each
+
+_P_WORDS = np.frombuffer(_P.to_bytes(32, "little"), np.uint64)
+_L_WORDS = np.frombuffer(_L.to_bytes(32, "little"), np.uint64)
+
+
+def _lt_words(vals: np.ndarray, bound_words: np.ndarray) -> np.ndarray:
+    """(N, 32) uint8 little-endian < bound, vectorized per 64-bit word."""
+    words = vals.copy().view(np.uint64)  # (N, 4)
+    lt = np.zeros(len(vals), bool)
+    gt = np.zeros(len(vals), bool)
+    for w in (3, 2, 1, 0):
+        lt |= ~gt & ~lt & (words[:, w] < bound_words[w])
+        gt |= ~gt & ~lt & (words[:, w] > bound_words[w])
+    return lt
+
+
+@functools.cache
+def _kernel():
+    import jax
+    import jax.numpy as jnp
+
+    from . import edwards as ed
+    from . import ristretto as rs
+    from . import scalar as sc
+
+    @jax.jit
+    def kernel(ab, rb, kdig, sdig, a_pre, r_pre, s_ok, btab):
+        n = ab.shape[0]
+        a_limbs = sc.bytes_to_limbs(ab.astype(jnp.int32).T, 22)
+        r_limbs = sc.bytes_to_limbs(rb.astype(jnp.int32).T, 22)
+        # Fused 2N ristretto decode (one sqrt-ratio dispatch, like the
+        # ed25519 kernel's fused A/R decompression).
+        limbs2 = jnp.concatenate([a_limbs, r_limbs], axis=1)
+        pre2 = jnp.concatenate([jnp.asarray(a_pre), jnp.asarray(r_pre)])
+        p2, ok2 = rs.decode(limbs2, pre2)
+        A = ed.Point(p2.x[:, :n], p2.y[:, :n], p2.z[:, :n], p2.t[:, :n])
+        R = ed.Point(p2.x[:, n:], p2.y[:, n:], p2.z[:, n:], p2.t[:, n:])
+        a_ok, r_ok = ok2[:n], ok2[n:]
+
+        neg_a = ed.neg(A)
+        tbl = ed.build_window_table(neg_a, 16)
+
+        def body(w, accs):
+            acc_a, acc_b = accs
+            # [k](-A): MSB-first windows with 4 doublings between.
+            acc_a = ed.double(ed.double(ed.double(ed.double(acc_a))))
+            dk = jax.lax.dynamic_index_in_dim(
+                kdig, _WINDOWS - 1 - w, 0, keepdims=False)
+            acc_a = ed.add(acc_a, ed.select(tbl, dk))
+            # [s]B: LSB-first comb over the shared base tables.
+            ds = jax.lax.dynamic_index_in_dim(sdig, w, 0, keepdims=False)
+            bw = jax.lax.dynamic_index_in_dim(btab, w, 0, keepdims=False)
+            qx, qy, qt = ed.select_const(bw, ds)
+            acc_b = ed.add_z1(acc_b, qx, qy, qt)
+            return (acc_a, acc_b)
+
+        acc_a, acc_b = jax.lax.fori_loop(
+            0, _WINDOWS, body, (ed.identity(n), ed.identity(n))
+        )
+        v = ed.add(acc_a, acc_b)
+        return rs.equal(v, R) & a_ok & r_ok & jnp.asarray(s_ok)
+
+    return kernel
+
+
+def _nibbles(ints, n: int) -> np.ndarray:
+    """(N,) python ints < 2^256 -> (64, N) int32 nibbles LSB-first."""
+    raw = np.frombuffer(
+        b"".join(int(v).to_bytes(32, "little") for v in ints), np.uint8
+    ).reshape(n, 32)
+    out = np.empty((64, n), np.int32)
+    out[0::2] = (raw & 0x0F).T
+    out[1::2] = (raw >> 4).T
+    return out
+
+
+def verify_batch_sr(pubs, msgs, sigs, ctx: bytes = b"") -> np.ndarray:
+    """Batched schnorrkel verify on the default JAX device.
+
+    Returns per-lane verdicts (N,) bool; semantics identical to
+    sr25519_ref.verify (marker bit required, canonical s < L,
+    ristretto-canonical A and R encodings).
+    """
+    from ..merlin_batch import sr25519_challenges
+
+    n = len(pubs)
+    assert len(msgs) == n and len(sigs) == n
+    if n == 0:
+        return np.zeros(0, bool)
+
+    well_formed = np.fromiter(
+        ((len(p) == 32 and len(s) == 64 and (s[63] & 0x80) != 0)
+         for p, s in zip(pubs, sigs)),
+        bool, count=n)
+    safe_sigs = [
+        s if ok else b"\0" * 63 + b"\x80"
+        for s, ok in zip(sigs, well_formed)
+    ]
+    safe_pubs = [p if ok else b"\0" * 32
+                 for p, ok in zip(pubs, well_formed)]
+
+    a_raw = np.frombuffer(b"".join(safe_pubs), np.uint8).reshape(n, 32)
+    sig_raw = np.frombuffer(b"".join(safe_sigs), np.uint8).reshape(n, 64)
+    r_raw = np.ascontiguousarray(sig_raw[:, :32])
+    s_raw = np.ascontiguousarray(sig_raw[:, 32:])
+    s_raw[:, 31] &= 0x7F  # strip schnorrkel marker bit
+
+    # Host preconditions: s < L; A/R canonical (< p) and non-negative.
+    s_ok = _lt_words(s_raw, _L_WORDS)
+    a_pre = _lt_words(a_raw, _P_WORDS) & ((a_raw[:, 0] & 1) == 0)
+    r_pre = _lt_words(r_raw, _P_WORDS) & ((r_raw[:, 0] & 1) == 0)
+
+    # Merlin challenges (SIMD host; transcript sees the WIRE bytes of
+    # pk and R, marker included on neither — R is sig[:32] as-is).
+    ks = sr25519_challenges(a_raw, list(msgs), r_raw, ctx)
+    kdig = _nibbles(ks, n)
+    s_ints = [int.from_bytes(s_raw[i].tobytes(), "little") for i in range(n)]
+    sdig = _nibbles(s_ints, n)
+
+    # Pad to a power-of-two bucket (same policy as the ed25519 path).
+    bucket = tv._MIN_BATCH
+    while bucket < n:
+        bucket <<= 1
+    pad = bucket - n
+    if pad:
+        a_raw = np.pad(a_raw, ((0, pad), (0, 0)))
+        r_raw = np.pad(r_raw, ((0, pad), (0, 0)))
+        kdig = np.pad(kdig, ((0, 0), (0, pad)))
+        sdig = np.pad(sdig, ((0, 0), (0, pad)))
+        s_ok = np.pad(s_ok, (0, pad))
+        a_pre = np.pad(a_pre, (0, pad))
+        r_pre = np.pad(r_pre, (0, pad))
+
+    btab = tv.b_comb_tables()[:_WINDOWS]
+    mesh = tv._mesh()
+    args = dict(ab=a_raw, rb=r_raw, kdig=kdig, sdig=sdig,
+                a_pre=a_pre, r_pre=r_pre, s_ok=s_ok)
+    if (mesh is not None and bucket >= tv._SHARD_MIN
+            and bucket % mesh.devices.size == 0):
+        import jax
+
+        row_s, vec_s, repl_s = tv._shardings(mesh)
+        for key, v in args.items():
+            if v.ndim == 1:
+                args[key] = jax.device_put(v, vec_s)
+            elif key in ("kdig", "sdig"):
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                args[key] = jax.device_put(
+                    v, NamedSharding(mesh, PartitionSpec(None, "dp")))
+            else:
+                args[key] = jax.device_put(v, row_s)
+        btab = jax.device_put(btab, repl_s)
+    out = _kernel()(btab=btab, **args)
+    return np.asarray(out)[:n] & well_formed

@@ -1,0 +1,232 @@
+"""Symmetric crypto + armor + trust metric + behaviour reporter
+(reference: crypto/xchacha20poly1305, crypto/xsalsa20symmetric,
+crypto/armor, p2p/trust/metric.go, behaviour/reporter.go)."""
+
+import asyncio
+import struct
+
+import pytest
+
+from tendermint_tpu.crypto.armor import decode_armor, encode_armor
+from tendermint_tpu.crypto.symmetric import (
+    XChaCha20Poly1305, _chacha_rounds, _CHACHA_CONST, decrypt_symmetric,
+    encrypt_symmetric, hchacha20,
+)
+
+
+def test_chacha_core_matches_openssl():
+    """The pure-Python ChaCha20 rounds (used by HChaCha20) must match
+    OpenSSL's ChaCha20: keystream block = serialize(rounds(state) +
+    state), so rounds(state) = deserialize(keystream) - state."""
+    from cryptography.hazmat.backends import default_backend
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms
+
+    key = bytes(range(32))
+    full_nonce = bytes(range(100, 116))  # counter(4) || nonce(12)
+    ks = Cipher(
+        algorithms.ChaCha20(key, full_nonce), mode=None,
+        backend=default_backend(),
+    ).encryptor().update(b"\x00" * 64)
+    state = list(_CHACHA_CONST) + list(struct.unpack("<8I", key)) + \
+        list(struct.unpack("<4I", full_nonce))
+    got = _chacha_rounds(state)
+    want = [
+        (w - s) & 0xFFFFFFFF
+        for w, s in zip(struct.unpack("<16I", ks), state)
+    ]
+    assert got == want
+
+
+def test_hchacha20_draft_vector():
+    """draft-irtf-cfrg-xchacha-03 §2.2.1 test vector."""
+    key = bytes.fromhex(
+        "000102030405060708090a0b0c0d0e0f"
+        "101112131415161718191a1b1c1d1e1f")
+    nonce = bytes.fromhex("000000090000004a0000000031415927")
+    want = bytes.fromhex(
+        "82413b4227b27bfed30e42508a877d73"
+        "a0f9e4d58a74a853c12ec41326d3ecdc")
+    assert hchacha20(key, nonce) == want
+
+
+def test_xchacha20poly1305_roundtrip_and_tamper():
+    key = bytes(range(32))
+    aead = XChaCha20Poly1305(key)
+    nonce = bytes(range(24))
+    for pt, aad in [(b"", b""), (b"hello world", b""),
+                    (b"x" * 1000, b"header")]:
+        ct = aead.seal(nonce, pt, aad)
+        assert len(ct) == len(pt) + 16
+        assert aead.open(nonce, ct, aad) == pt
+    ct = aead.seal(nonce, b"secret", b"aad")
+    with pytest.raises(ValueError):
+        aead.open(nonce, ct[:-1] + bytes([ct[-1] ^ 1]), b"aad")
+    with pytest.raises(ValueError):
+        aead.open(nonce, ct, b"other-aad")
+    with pytest.raises(ValueError):
+        aead.open(bytes(24), ct, b"aad")
+    # different nonces -> different ciphertexts
+    assert aead.seal(bytes(24), b"m") != aead.seal(bytes(23) + b"\x01", b"m")
+    with pytest.raises(ValueError):
+        XChaCha20Poly1305(b"short")
+    with pytest.raises(ValueError):
+        aead.seal(b"short-nonce", b"m")
+
+
+def test_xsalsa20symmetric_roundtrip_and_tamper():
+    secret = bytes(range(32))
+    for pt in (b"", b"the quick brown fox", b"z" * 4096):
+        box = encrypt_symmetric(pt, secret)
+        assert len(box) == 24 + 16 + len(pt)
+        assert decrypt_symmetric(box, secret) == pt
+    box = encrypt_symmetric(b"attack at dawn", secret)
+    # tampered ciphertext, tag, and wrong key all fail
+    for mutated in (
+        box[:-1] + bytes([box[-1] ^ 1]),
+        box[:24] + bytes(16) + box[40:],
+    ):
+        with pytest.raises(ValueError):
+            decrypt_symmetric(mutated, secret)
+    with pytest.raises(ValueError):
+        decrypt_symmetric(box, bytes(32))
+    with pytest.raises(ValueError):
+        decrypt_symmetric(b"short", secret)
+    with pytest.raises(ValueError):
+        encrypt_symmetric(b"x", b"badkey")
+    # random nonces: same message encrypts differently
+    assert encrypt_symmetric(b"m", secret) != encrypt_symmetric(b"m", secret)
+
+
+def test_armor_roundtrip():
+    data = bytes(range(256)) * 3
+    s = encode_armor("TENDERMINT PRIVATE KEY",
+                     {"kdf": "bcrypt", "salt": "ABCD"}, data)
+    bt, headers, out = decode_armor(s)
+    assert bt == "TENDERMINT PRIVATE KEY"
+    assert headers == {"kdf": "bcrypt", "salt": "ABCD"}
+    assert out == data
+    # corrupted payload trips the CRC-24
+    bad = s.replace(s.split("\n")[3][:8], "AAAAAAAA", 1)
+    if bad != s:
+        with pytest.raises(ValueError):
+            decode_armor(bad)
+    with pytest.raises(ValueError):
+        decode_armor("no armor here")
+    with pytest.raises(ValueError):
+        decode_armor(s.replace("END TENDERMINT", "END OTHER"))
+
+
+# --- trust metric ---
+
+
+def test_trust_metric_behavior():
+    from tendermint_tpu.p2p.trust import TrustMetric
+
+    m = TrustMetric(interval_s=1.0)
+    assert m.trust_value() == 1.0  # perfect history to start
+    m.bad_events(10)
+    v_bad = m.trust_value()
+    assert v_bad < 1.0
+    m.good_events(90)
+    v_mixed = m.trust_value()
+    assert v_bad < v_mixed < 1.0
+    # bank intervals of all-bad conduct: trust decays monotonically
+    prev = m.trust_value()
+    for _ in range(8):
+        m.tick()
+        m.bad_events(5)
+        v = m.trust_value()
+        assert v <= prev + 1e-9
+        prev = v
+    assert m.trust_value() < 0.5
+    assert 0 <= m.trust_score() <= 100
+    # recovery: sustained good conduct raises it again
+    for _ in range(16):
+        m.tick()
+        m.good_events(50)
+    assert m.trust_value() > 0.6
+    # pause freezes; next event resets the current interval
+    m.pause()
+    m.tick()
+    m.bad_events(1)
+    assert not m.paused
+
+
+def test_trust_metric_persistence_roundtrip():
+    from tendermint_tpu.libs.db import MemDB
+    from tendermint_tpu.p2p.trust import TrustMetric, TrustMetricStore
+
+    store = TrustMetricStore(MemDB())
+    m = store.get_metric("peer1")
+    m.bad_events(5)
+    for _ in range(4):
+        m.tick()
+        m.bad_events(3)
+    score = m.trust_score()
+    store.save()
+    store2 = TrustMetricStore(store.db)
+    m2 = store2.get_metric("peer1")
+    assert m2.num_intervals == m.num_intervals
+    assert m2.paused  # reloaded metrics start paused
+    assert abs(m2.history_value - m.history_value) < 1e-9
+    assert score < 100
+
+
+def test_behaviour_reporter_trust_integration():
+    from tendermint_tpu.behaviour import (
+        MockReporter, PeerBehaviour, SwitchReporter,
+    )
+
+    class FakeSwitch:
+        def __init__(self):
+            self.peers = {"p1": object()}
+            self.stopped = []
+
+        async def stop_peer_for_error(self, peer, reason):
+            self.stopped.append((peer, reason))
+
+    async def go():
+        sw = FakeSwitch()
+        rep = SwitchReporter(sw)
+        # good conduct: no disconnect, score stays high
+        for _ in range(10):
+            await rep.report(PeerBehaviour.consensus_vote("p1"))
+        assert not sw.stopped
+        assert rep.trust.get_metric("p1").trust_score() > 90
+        # an order violation is a hard fault -> immediate stop
+        await rep.report(
+            PeerBehaviour.message_out_of_order("p1", "bc seq"))
+        assert len(sw.stopped) == 1
+        # soft faults accumulate until the trust score collapses
+        sw2 = FakeSwitch()
+        rep2 = SwitchReporter(sw2, stop_score=35)
+        for i in range(60):
+            await rep2.report(PeerBehaviour.bad_message("p1", f"junk {i}"))
+            for _ in range(3):
+                rep2.trust.get_metric("p1").tick()
+        assert sw2.stopped, "collapsed trust never disconnected the peer"
+        # reports for unknown peers never raise
+        await rep2.report(PeerBehaviour.bad_message("ghost", "x"))
+        # mock records
+        mock = MockReporter()
+        await mock.report(PeerBehaviour.block_part("p9"))
+        assert mock.reports["p9"][0].kind == "block_part"
+
+    asyncio.run(go())
+
+
+def test_encrypted_keyfile_roundtrip():
+    from tendermint_tpu.crypto.keyfile import (
+        encrypt_armor_priv_key, unarmor_decrypt_priv_key,
+    )
+
+    priv = bytes(range(32))
+    armored = encrypt_armor_priv_key(priv, "hunter2")
+    assert "TENDERMINT PRIVATE KEY" in armored
+    assert "kdf: scrypt" in armored
+    out, ktype = unarmor_decrypt_priv_key(armored, "hunter2")
+    assert out == priv and ktype == "ed25519"
+    with pytest.raises(ValueError):
+        unarmor_decrypt_priv_key(armored, "wrong-pass")
+    # same key re-armored encrypts differently (fresh salt + nonce)
+    assert armored != encrypt_armor_priv_key(priv, "hunter2")
