@@ -422,6 +422,34 @@ class ConsensusReactor(Reactor):
         elif event == "has_vote":
             self.switch.broadcast(STATE_CHANNEL,
                                   m.encode_consensus_msg(payload))
+        elif event == "vote_split":
+            # Maverick equivocation (consensus/misbehavior.py): every
+            # peer receives BOTH conflicting votes, in alternating
+            # order. (Sending each half to half the peers — the
+            # reference maverick's split — makes evidence creation a
+            # race against the commit: prevotes stop being gossiped
+            # once the height advances. Delivering both directly makes
+            # the conflict, and thus DuplicateVoteEvidence, determinate
+            # while still exercising the same add-vote conflict path.)
+            vote_a, vote_b = payload
+            for i, peer in enumerate(list(self.switch.peers.values())):
+                pair = (vote_a, vote_b) if i % 2 == 0 else (vote_b, vote_a)
+                for msg in pair:
+                    peer.try_send(VOTE_CHANNEL, m.encode_consensus_msg(msg))
+        elif event == "proposal_split":
+            # Maverick double-proposal: odd peers get the alternate
+            # proposal + its parts directly (even peers see the primary
+            # through normal gossip).
+            (_, _), (prop_b, parts_b) = payload
+            for i, peer in enumerate(list(self.switch.peers.values())):
+                if i % 2 == 0:
+                    continue
+                peer.try_send(DATA_CHANNEL, m.encode_consensus_msg(
+                    m.ProposalMessage(prop_b)))
+                for j in range(parts_b.total):
+                    peer.try_send(DATA_CHANNEL, m.encode_consensus_msg(
+                        m.BlockPartMessage(prop_b.height, prop_b.round,
+                                           parts_b.get_part(j))))
 
     # -- gossip routines --
 

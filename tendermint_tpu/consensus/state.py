@@ -81,8 +81,13 @@ class ConsensusState(Service):
         self._vote_pending = asyncio.Event()
         self._height_done = asyncio.Event()  # pulsed on every commit
         # reactor hooks: fn(event_name, payload); events: "step",
-        # "proposal", "block_part", "vote", "has_vote"
+        # "proposal", "block_part", "vote", "has_vote", and the
+        # maverick split events "vote_split"/"proposal_split"
         self.broadcast_hooks: list = []
+        # Maverick hook points (test/maverick analogue): height ->
+        # Misbehavior; consulted at enter_propose/prevote/precommit
+        # (consensus/misbehavior.py). Empty for honest nodes.
+        self.misbehaviors: dict = {}
 
         self.update_to_state(state)
         if state.last_block_height > 0:
@@ -368,6 +373,10 @@ class ConsensusState(Service):
             int(RoundStep.PROPOSE),
         ))
 
+        mb = self.misbehaviors.get(height)
+        if mb is not None and await mb.enter_propose(self, height, round_):
+            return
+
         if self._is_proposer() and self.priv_validator is not None:
             await self._decide_proposal(height, round_)
 
@@ -448,6 +457,9 @@ class ConsensusState(Service):
         ):
             return
         self._new_step(RoundStep.PREVOTE)
+        mb = self.misbehaviors.get(height)
+        if mb is not None and await mb.enter_prevote(self, height, round_):
+            return
         # reference defaultDoPrevote (state.go:1229)
         if rs.locked_block is not None:
             await self._sign_add_vote(VoteType.PREVOTE, rs.locked_block.hash(),
@@ -485,6 +497,9 @@ class ConsensusState(Service):
         ):
             return
         self._new_step(RoundStep.PRECOMMIT)
+        mb = self.misbehaviors.get(height)
+        if mb is not None and await mb.enter_precommit(self, height, round_):
+            return
         prevotes = rs.votes.prevotes(round_)
         bid, has_maj = (prevotes.two_thirds_majority()
                         if prevotes is not None else (None, False))

@@ -96,9 +96,23 @@ class BatchVerifier:
                 bool,
                 count=len(items),
             )
+        if type_name == "sr25519":
+            use_dev = self._use_device
+            if use_dev is None:
+                use_dev = len(items) >= _DEVICE_THRESHOLD
+            if use_dev:
+                from .tpu import sr_verify
+
+                met.batch_lanes.inc(len(items), backend="tpu-sr25519")
+                met.device_launches.inc()
+                return sr_verify.verify_batch_sr(
+                    [pk.bytes() for pk, _, _ in items],
+                    [m for _, m, _ in items],
+                    [s for _, _, s in items],
+                )
         met.batch_lanes.inc(len(items), backend=f"host-{type_name}")
-        # Other key types (sr25519, secp256k1): host-side one-by-one via
-        # the PubKey objects we already hold.
+        # Remaining key types (secp256k1; small sr25519 groups):
+        # host-side one-by-one via the PubKey objects we already hold.
         return np.fromiter(
             (pk.verify_signature(m, s) for pk, m, s in items),
             bool,

@@ -1,0 +1,11 @@
+"""Manifest-driven end-to-end testnet runner with perturbations
+(reference: test/e2e/runner/ — setup/start/load/perturb/test/cleanup,
+perturb.go:12-60; manifest schema test/e2e/pkg/manifest.go).
+
+Where the reference drives docker-compose containers, this runner
+drives real node SUBPROCESSES (`python -m tendermint_tpu.cmd start`)
+on localhost — same process-level fault model (SIGKILL, SIGSTOP,
+restart) without a container runtime."""
+
+from .manifest import Manifest, Perturbation  # noqa: F401
+from .runner import Runner  # noqa: F401

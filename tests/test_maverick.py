@@ -1,0 +1,98 @@
+"""Maverick (byzantine) node misbehavior hooks
+(reference: test/maverick/consensus/misbehavior.go): a REAL misbehaving
+node in a live net — not injected forged votes — whose equivocation is
+detected by honest peers, turned into DuplicateVoteEvidence, gossiped,
+and committed."""
+
+import asyncio
+
+from tendermint_tpu.consensus.misbehavior import (
+    MISBEHAVIORS, DoublePrevote, DoublePropose, Misbehavior,
+)
+
+from p2p_harness import make_net
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_registry():
+    assert MISBEHAVIORS["double-prevote"] is DoublePrevote
+    assert MISBEHAVIORS["double-propose"] is DoublePropose
+
+
+def test_default_misbehavior_falls_through():
+    async def go():
+        mb = Misbehavior()
+        assert not await mb.enter_propose(None, 1, 0)
+        assert not await mb.enter_prevote(None, 1, 0)
+        assert not await mb.enter_precommit(None, 1, 0)
+
+    run(go())
+
+
+def test_double_prevote_equivocation_evidence_committed():
+    """A maverick validator double-prevotes at height 2; the net keeps
+    committing blocks AND the equivocation lands on-chain as
+    DuplicateVoteEvidence on every node."""
+    async def go():
+        nodes = await make_net(4)
+        try:
+            maverick = nodes[3]
+            maverick.cs.misbehaviors[2] = DoublePrevote()
+
+            def committed_evidence(node):
+                for h in range(1, node.block_store.height + 1):
+                    b = node.block_store.load_block(h)
+                    if b is not None and b.evidence.evidence:
+                        return b.evidence.evidence
+                return None
+
+            for _ in range(1200):
+                if all(committed_evidence(n) for n in nodes):
+                    break
+                await asyncio.sleep(0.05)
+            evs = [committed_evidence(n) for n in nodes]
+            assert all(evs), "equivocation evidence never committed " \
+                f"(per-node: {[bool(e) for e in evs]})"
+            from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+
+            ev = evs[0][0]
+            assert isinstance(ev, DuplicateVoteEvidence)
+            assert ev.vote_a.validator_address == \
+                maverick.pv.get_pub_key().address()
+            assert ev.vote_a.height == 2
+            # the chain kept making progress past the attack height
+            await asyncio.gather(
+                *(n.cs.wait_for_height(4, timeout=60) for n in nodes))
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    run(go())
+
+
+def test_double_propose_net_survives():
+    """A maverick proposer signs two conflicting proposals at height 2;
+    the net must keep committing (one of the proposals wins or the
+    round advances) — safety is never violated: all nodes agree on
+    every height's block hash."""
+    async def go():
+        nodes = await make_net(4)
+        try:
+            # every node schedules it: whoever ends up proposer at h=2
+            # equivocates
+            for n in nodes:
+                n.cs.misbehaviors[2] = DoublePropose()
+            await asyncio.gather(
+                *(n.cs.wait_for_height(4, timeout=120) for n in nodes))
+            for h in range(1, 4):
+                hashes = {n.block_store.load_block_meta(h).header.hash()
+                          for n in nodes}
+                assert len(hashes) == 1, f"fork at height {h}!"
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    run(go())
