@@ -36,11 +36,24 @@ def worker():
     """Runs in a subprocess: do the measurement, print the JSON line."""
     import hashlib
 
+    if "--cpu" in sys.argv:
+        # The env var alone does NOT override this machine's axon
+        # sitecustomize; the config update is what actually wins (same
+        # dance as tests/conftest.py). Must run before any device use.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
     import numpy as np  # noqa: F401  (keeps import cost out of timings)
 
     from tendermint_tpu.crypto.tpu import verify as tv
 
     n = 10240  # 10k validators, one CommitSig each
+    for arg in sys.argv:
+        if arg.startswith("--batch="):
+            n = int(arg.split("=", 1)[1])
     baseline_estimated = False
     try:
         from cryptography.hazmat.primitives import serialization
@@ -153,11 +166,16 @@ def worker():
     )
 
 
-def _run_attempt(env=None):
+def _run_attempt(env=None, batch=None, cpu=False):
     """One worker attempt; returns the JSON line or an error string."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker"]
+    if batch:
+        cmd.append(f"--batch={batch}")
+    if cpu:
+        cmd.append("--cpu")
     try:
         p = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--worker"],
+            cmd,
             capture_output=True, text=True, timeout=ATTEMPT_TIMEOUT_S,
             env=env,
         )
@@ -186,12 +204,24 @@ def main():
         if attempt < ATTEMPTS - 1:
             time.sleep(BACKOFF_S)
 
+    # Full-size attempts failed. A 1,024-lane run may still succeed
+    # (round 2's suspected failure mode was the 3.3 GB 10k-key table
+    # build wedging the relay) — a measured number at reduced batch,
+    # clearly flagged, beats a number-less round.
+    line, err = _run_attempt(batch=1024)
+    if line is not None:
+        d = json.loads(line)
+        d["reduced_batch"] = True
+        d["error"] = ("full 10240-lane run failed; value measured at "
+                      "batch=1024: " + "; ".join(errors)[:1200])
+        print(json.dumps(d))
+        return
+
     # The accelerator never came up. Emit the JSON line anyway, with
     # the failure recorded and a flagged CPU-mesh fallback number so
     # the round is never number-less (VERDICT r2 weak #1).
     fallback = {}
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    line, err = _run_attempt(env=env)
+    line, err = _run_attempt(batch=1024, cpu=True)
     if line is not None:
         d = json.loads(line)
         fallback = {

@@ -69,16 +69,38 @@ class SwitchReporter(Reporter):
     def _peer(self, peer_id: str):
         return self.switch.peers.get(peer_id)
 
-    async def report(self, behaviour: PeerBehaviour) -> None:
-        metric = self.trust.get_metric(behaviour.peer_id)
+    def observe(self, peer_id: str, good: int = 0, bad: int = 0) -> None:
+        """Synchronous bulk metric update — the consensus vote batch
+        path calls this once per peer per batch with verified/rejected
+        lane counts (crediting only VERIFIED contributions; crediting
+        on receive would let a byzantine peer stream well-formed
+        garbage and keep a perfect score)."""
+        m = self.trust.get_metric(peer_id)
+        if good:
+            m.good_events(good)
+        if bad:
+            m.bad_events(bad)
         self.trust.maybe_tick()
-        peer = self._peer(behaviour.peer_id)
+
+    async def enforce(self, peer_id: str, reason: str) -> None:
+        """Disconnect the peer if its trust score has collapsed
+        (called after observe() recorded bad conduct)."""
+        peer = self._peer(peer_id)
+        if peer is None:
+            return
+        score = self.trust.get_metric(peer_id).trust_score()
+        if score < self.stop_score:
+            await self.switch.stop_peer_for_error(
+                peer, f"trust score {score} < {self.stop_score}: {reason}")
+
+    async def report(self, behaviour: PeerBehaviour) -> None:
         if behaviour.kind in GOOD_KINDS:
-            metric.good_events(1)
+            self.observe(behaviour.peer_id, good=1)
             return
         if behaviour.kind not in BAD_KINDS:
             raise ValueError(f"unknown behaviour kind {behaviour.kind!r}")
-        metric.bad_events(1)
+        self.observe(behaviour.peer_id, bad=1)
+        peer = self._peer(behaviour.peer_id)
         if peer is None:
             return
         if behaviour.kind == "message_out_of_order":
@@ -86,11 +108,9 @@ class SwitchReporter(Reporter):
             # stops the peer immediately for these).
             await self.switch.stop_peer_for_error(
                 peer, behaviour.explanation)
-        elif metric.trust_score() < self.stop_score:
+        else:
             # Soft faults accumulate; disconnect on collapsed trust.
-            await self.switch.stop_peer_for_error(
-                peer, f"trust score {metric.trust_score()} < "
-                      f"{self.stop_score}: {behaviour.explanation}")
+            await self.enforce(behaviour.peer_id, behaviour.explanation)
 
     def disconnected(self, peer_id: str) -> None:
         self.trust.peer_disconnected(peer_id)

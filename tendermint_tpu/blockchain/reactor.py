@@ -262,6 +262,10 @@ class BlockchainReactor(Reactor):
                         "peer %s", h, err, peer_id,
                     )
                     if sw is not None and peer_id in sw.peers:
+                        rep = getattr(sw, "reporter", None)
+                        if rep is not None:
+                            # feed the trust metric before the hard stop
+                            rep.observe(peer_id, bad=1)
                         sw._on_peer_error(sw.peers[peer_id],
                                           RuntimeError(f"bad block: {err}"))
                 break

@@ -302,6 +302,28 @@ def cmd_show_node_id(args) -> int:
     return 0
 
 
+def cmd_probe_upnp(args) -> int:
+    """reference: cmd/tendermint/commands/probe_upnp.go."""
+    from ..p2p.upnp import UPnPError, discover
+
+    async def go() -> int:
+        try:
+            igd = await discover(timeout=args.timeout)
+        except UPnPError as e:
+            print(json.dumps({"success": False, "error": str(e)}))
+            return 1
+        out = {"success": True, "control_url": igd.control_url,
+               "local_ip": igd.local_ip}
+        try:
+            out["external_ip"] = igd.external_ip()
+        except UPnPError as e:
+            out["external_ip_error"] = str(e)
+        print(json.dumps(out))
+        return 0
+
+    return asyncio.run(go())
+
+
 def cmd_version(args) -> int:
     print(VERSION)
     return 0
@@ -358,6 +380,11 @@ def build_parser() -> argparse.ArgumentParser:
     from .debug import register as register_debug
 
     register_debug(sub)
+
+    sp = sub.add_parser("probe-upnp",
+                        help="probe for a UPnP internet gateway")
+    sp.add_argument("--timeout", type=float, default=3.0)
+    sp.set_defaults(fn=cmd_probe_upnp)
 
     sub.add_parser("gen-validator").set_defaults(fn=cmd_gen_validator)
     sub.add_parser("show-validator").set_defaults(fn=cmd_show_validator)

@@ -240,6 +240,10 @@ class ConsensusReactor(Reactor):
         self.peer_states: dict[str, PeerState] = {}
         self._peer_tasks: dict[str, list[asyncio.Task]] = {}
         cs.broadcast_hooks.append(self._on_cs_event)
+        # Lets the state machine feed verified/rejected vote counts
+        # into the trust metric (behaviour.SwitchReporter) without
+        # knowing about the p2p layer.
+        cs.reporter_fn = lambda: getattr(self.switch, "reporter", None)
 
     def get_channels(self) -> list[ChannelDescriptor]:
         # priorities/capacities follow reference reactor.go GetChannels
@@ -350,6 +354,11 @@ class ConsensusReactor(Reactor):
                                 v.validator_index)
                 ps.votes_received += 1
                 self.cs.add_peer_msg(msg, peer.id)
+                # NOTE: no trust credit here — votes are credited (or
+                # debited) by the state machine AFTER signature
+                # verification (state.py _verify_and_commit_batch);
+                # crediting decodable-but-unverified votes would let a
+                # byzantine peer farm reputation with garbage.
             else:
                 raise ValueError(f"bad msg on vote channel: {type(msg)}")
         elif chan_id == VOTE_SET_BITS_CHANNEL:

@@ -88,6 +88,9 @@ class ConsensusState(Service):
         # Misbehavior; consulted at enter_propose/prevote/precommit
         # (consensus/misbehavior.py). Empty for honest nodes.
         self.misbehaviors: dict = {}
+        # () -> behaviour.SwitchReporter | None; set by the reactor so
+        # verified/rejected vote counts feed the peer trust metric.
+        self.reporter_fn = lambda: None
 
         self.update_to_state(state)
         if state.last_block_height > 0:
@@ -845,7 +848,11 @@ class ConsensusState(Service):
             _, verdicts = await loop.run_in_executor(None, bv.verify)
         else:
             _, verdicts = bv.verify()
+        per_peer: dict[str, list[int]] = {}  # peer -> [good, bad]
         for (vote, peer_id, _), ok in zip(batch, verdicts):
+            if peer_id:
+                counts = per_peer.setdefault(peer_id, [0, 0])
+                counts[0 if ok else 1] += 1
             if not ok:
                 self.logger.debug(
                     "batch-verify rejected vote from %r (val %s)",
@@ -854,6 +861,16 @@ class ConsensusState(Service):
                 continue
             async with self._state_mtx:
                 await self._try_add_vote(vote, peer_id, preverified=True)
+        # Trust metric feedback on VERIFIED outcomes: credit good
+        # lanes, debit rejected ones, disconnect on collapsed trust
+        # (behaviour.py; a peer streaming well-formed-but-invalid
+        # votes decays to a stop instead of farming reputation).
+        rep = self.reporter_fn()
+        if rep is not None:
+            for peer_id, (good, bad) in per_peer.items():
+                rep.observe(peer_id, good=good, bad=bad)
+                if bad:
+                    await rep.enforce(peer_id, "invalid vote signature")
 
     async def _try_add_vote(self, vote: Vote, peer_id: str,
                             preverified: bool = False) -> bool:

@@ -184,6 +184,15 @@ def _xkernel():
 class ExpandedKeys:
     """Device-resident comb tables for a fixed list of ed25519 pubkeys."""
 
+    # Keys per build launch. The builder materializes ~3 stacked
+    # copies of its output (scan rows + pad + transpose) — at 10k keys
+    # that is ~9 GB of transient HBM on top of the 3.3 GB result,
+    # within OOM distance of a 16 GB chip. Chunking bounds the
+    # transient to ~0.9 GB/launch; chunks concatenate on device and
+    # the per-key row blocks are contiguous, so the flat row-gather
+    # indexing is unchanged.
+    BUILD_CHUNK = 2048
+
     def __init__(self, pubkeys: list[bytes]):
         import jax.numpy as jnp
 
@@ -191,7 +200,29 @@ class ExpandedKeys:
         assert all(len(p) == 32 for p in self.pubkeys)
         a_raw = np.frombuffer(b"".join(self.pubkeys), np.uint8).reshape(-1, 32)
         self._a_raw = a_raw
-        tables, ok = _builder()(jnp.asarray(a_raw))
+        v = len(self.pubkeys)
+        if v <= self.BUILD_CHUNK:
+            tables, ok = _builder()(jnp.asarray(a_raw))
+        else:
+            # Pad to a chunk multiple (one compiled shape), build each
+            # chunk, concatenate on device. Padding keys are never
+            # addressed: verify() asserts idx < len(pubkeys).
+            chunk = self.BUILD_CHUNK
+            vp = (v + chunk - 1) // chunk * chunk
+            padded = np.zeros((vp, 32), np.uint8)
+            padded[:v] = a_raw
+            t_parts, ok_parts = [], []
+            for s in range(0, vp, chunk):
+                t, o = _builder()(jnp.asarray(padded[s:s + chunk]))
+                t_parts.append(t)
+                ok_parts.append(o)
+            tables = jnp.concatenate(t_parts, axis=0)
+            if vp != v:
+                # drop the padding keys' rows (up to chunk-1 keys ×
+                # ~318 KB each would otherwise sit in HBM — and be
+                # replicated per mesh chip — for the cache lifetime)
+                tables = tables[: v * _WINDOWS * _ENTRIES]
+            ok = jnp.concatenate(ok_parts)[:v]
         # Multi-chip: REPLICATE the tables over the ('dp',) mesh and
         # shard lanes at launch (same scheme as verify_batch). Lane
         # digits address arbitrary table rows, so a row-sharded table

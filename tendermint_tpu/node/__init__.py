@@ -193,6 +193,15 @@ class Node(Service):
         self.switch = Switch(self.transport, node_info,
                              max_inbound=cfg.p2p.max_num_inbound_peers,
                              max_outbound=cfg.p2p.max_num_outbound_peers)
+        # Peer-quality bookkeeping: EWMA trust metrics (persisted) fed
+        # by reactor behaviour reports; collapsed trust disconnects
+        # (behaviour.py, p2p/trust.py — reference behaviour/ + ADR-006)
+        from ..behaviour import SwitchReporter
+        from ..p2p.trust import TrustMetricStore
+
+        self.switch.reporter = SwitchReporter(
+            self.switch,
+            TrustMetricStore(_db(cfg, "trust", self.in_memory)))
         self.switch.add_reactor("consensus", self.consensus_reactor)
         self.switch.add_reactor("blockchain", self.bc_reactor)
         self.switch.add_reactor("mempool", self.mempool_reactor)
@@ -319,6 +328,8 @@ class Node(Service):
         await self.consensus_reactor.stop()
         if hasattr(self, "pex_reactor"):
             await self.pex_reactor.stop()
+        if self.switch.reporter is not None:
+            self.switch.reporter.trust.save()
         await self.switch.stop()
         await self.proxy_app.stop()
 

@@ -71,6 +71,7 @@ class Switch(Service):
         self.max_outbound = max_outbound
         self._reconnect_tasks: dict[str, asyncio.Task] = {}
         self.addr_book = None                    # set by PEX wiring
+        self.reporter = None                     # behaviour.SwitchReporter
 
     # -- assembly --
 
@@ -225,6 +226,8 @@ class Switch(Service):
 
     async def _remove_peer(self, peer: Peer, reason) -> None:
         self.peers.pop(peer.id, None)
+        if self.reporter is not None:
+            self.reporter.disconnected(peer.id)  # pause its trust metric
         for r in self.reactors.values():
             try:
                 await r.remove_peer(peer, reason)

@@ -1,0 +1,166 @@
+"""Generate the light-client MBT fixture corpus
+(tests/light_fixtures/*.json) — run from repo root:
+
+    JAX_PLATFORMS=cpu python tests/gen_light_fixtures.py
+
+Covers the trust-expiry x adjacency x valset-rotation x attack lattice
+(reference: light/mbt's TLA+-generated corpus; generation here is our
+own, from the deterministic LightChain harness)."""
+
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tendermint_tpu.light.types import LightBlock, SignedHeader  # noqa: E402
+from tendermint_tpu.types.block import BlockID, PartSetHeader  # noqa: E402
+
+from helpers import CHAIN_ID, sign_commit  # noqa: E402
+from test_light import HOUR, LightChain, T0, _valset  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(__file__), "light_fixtures")
+
+
+def hx(lb: LightBlock) -> str:
+    return lb.to_proto().finish().hex()
+
+
+def sec(n: float) -> int:
+    return int(n * 1_000_000_000)
+
+
+def fixture(name, description, chain, initial_h, steps,
+            trusting_period=HOUR, now=T0 + sec(100), trust_level=(1, 3)):
+    doc = {
+        "description": description,
+        "chain_id": CHAIN_ID,
+        "trust_level": list(trust_level),
+        "initial": {
+            "block": hx(chain.blocks[initial_h])
+            if isinstance(initial_h, int) else hx(initial_h),
+            "trusting_period_ns": trusting_period,
+            "now_ns": now,
+        },
+        "input": [
+            {"block": hx(chain.blocks[h]) if isinstance(h, int) else hx(h),
+             "now_ns": step_now, "verdict": verdict}
+            for (h, step_now, verdict) in steps
+        ],
+    }
+    path = os.path.join(OUT, f"{name}.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"wrote {path}")
+
+
+def forged_app_hash(lb: LightBlock) -> LightBlock:
+    """Header field changed, commit NOT re-signed: hash mismatch."""
+    forged = dataclasses.replace(lb.signed_header.header,
+                                 app_hash=b"\xee" * 32)
+    return LightBlock(SignedHeader(forged, lb.signed_header.commit),
+                      lb.validator_set)
+
+
+def resigned_by(lb: LightBlock, indices) -> LightBlock:
+    """The same header validly re-signed by a DIFFERENT valset whose
+    hash doesn't match the header (attack block)."""
+    vals, pvs = _valset(indices)
+    h = lb.signed_header.header
+    bid = BlockID(h.hash(), PartSetHeader(1, b"\x07" * 32))
+    commit = sign_commit(vals, pvs, CHAIN_ID, h.height, 0, bid,
+                         h.time + 1)
+    return LightBlock(SignedHeader(h, commit), vals)
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    NOW = T0 + sec(100)
+
+    # 1. Happy adjacent sequence, static valset.
+    c = LightChain(6)
+    fixture("adjacent_happy", "sequential adjacent verification", c, 1,
+            [(2, NOW, "SUCCESS"), (3, NOW, "SUCCESS"),
+             (4, NOW, "SUCCESS"), (5, NOW, "SUCCESS")])
+
+    # 2. Happy skipping: full overlap.
+    fixture("skipping_happy", "non-adjacent jump with full overlap",
+            c, 1, [(6, NOW, "SUCCESS")])
+
+    # 3. Gradual rotation: one validator swaps per height; adjacent
+    # steps fine, and a 3-height jump still has >=1/3 overlap.
+    rot = LightChain(8, valset_for=lambda h: tuple(
+        (h + i) % 10 for i in range(4)))
+    fixture("rotation_adjacent", "rotating valset, adjacent steps",
+            rot, 1, [(2, NOW, "SUCCESS"), (3, NOW, "SUCCESS")])
+    fixture("rotation_skip_partial",
+            "3-height jump across rotation keeps 1/4 overlap "
+            "(10/40 power < 1/3): bisection signal",
+            rot, 1, [(4, NOW, "NOT_ENOUGH_TRUST"),
+                     (2, NOW, "SUCCESS"),  # bisect: adjacent works
+                     (4, NOW, "SUCCESS")])  # now 2/4 overlap >= 1/3
+
+    # 4. Full rotation: disjoint valsets -> NOT_ENOUGH_TRUST on jump.
+    full = LightChain(8, valset_for=lambda h: tuple(
+        range(4) if h <= 2 else range(10, 14)))
+    fixture("rotation_skip_disjoint",
+            "target signed by a fully rotated (disjoint) valset",
+            full, 1, [(5, NOW, "NOT_ENOUGH_TRUST"),
+                      (2, NOW, "SUCCESS"),   # adjacent: hash-linked
+                      (3, NOW, "SUCCESS"),   # adjacent across the swap
+                      (5, NOW, "SUCCESS")])
+
+    # 5. Trust expiry: trusted header older than the trusting period.
+    fixture("trust_expired", "trusted block outside trusting period",
+            c, 1, [(3, T0 + HOUR + sec(2), "INVALID")])
+    # 5b. ...but inside the period it verifies (boundary - 1).
+    fixture("trust_not_expired",
+            "same jump just inside the trusting period",
+            c, 1, [(3, T0 + HOUR - sec(1) + sec(1), "SUCCESS")])
+
+    # 6. Future header: untrusted time (T0+6s) beyond now + the 10s
+    # max clock drift.
+    fixture("clock_drift", "target header from the future",
+            c, 1, [(6, T0 - sec(5), "INVALID"),
+                   (6, NOW, "SUCCESS")])
+
+    # 7. Non-monotonic: target not above trusted height.
+    fixture("height_regression", "target height <= trusted height",
+            c, 3, [(2, NOW, "INVALID"), (3, NOW, "INVALID"),
+                   (4, NOW, "SUCCESS")])
+
+    # 8. Forged header (lunatic): commit signs the ORIGINAL hash.
+    fixture("forged_app_hash", "tampered app_hash, stale commit",
+            c, 1, [(forged_app_hash(c.blocks[3]), NOW, "INVALID")])
+
+    # 9. Attack: header re-signed by foreign valset (valset hash
+    # mismatch caught by validate_basic).
+    fixture("foreign_signers", "commit validly signed by outsiders",
+            c, 1, [(resigned_by(c.blocks[3], range(20, 24)), NOW,
+                    "INVALID")])
+
+    # 10. Adjacent with next-valset hash mismatch: chain c2's block 2
+    # claims a different valset than c told us at height 1.
+    c2 = LightChain(4, valset_for=lambda h: tuple(range(4)) if h == 1
+                    else tuple(range(4, 8)))
+    fixture("adjacent_valset_mismatch",
+            "adjacent header whose validators_hash doesn't match "
+            "trusted next_validators_hash",
+            c, 1, [(c2.blocks[2], NOW, "INVALID")])
+
+    # 11. Raised trust level: a 2/4 overlap passes 1/3 but fails 2/3.
+    half = LightChain(6, valset_for=lambda h: tuple(
+        range(4) if h <= 2 else (2, 3, 4, 5)))
+    fixture("trust_level_two_thirds",
+            "2/4 trusted-power overlap: enough for 1/3, not for 2/3",
+            half, 1, [(5, NOW, "NOT_ENOUGH_TRUST")],
+            trust_level=(2, 3))
+    fixture("trust_level_one_third",
+            "same jump at the default 1/3 trust level",
+            half, 1, [(5, NOW, "SUCCESS")], trust_level=(1, 3))
+
+
+if __name__ == "__main__":
+    main()

@@ -109,3 +109,41 @@ def test_two_node_net_via_persistent_peers(tmp_path):
             await nodes[0].stop()
 
     run(go())
+
+
+def test_trust_metric_wired_into_live_node(tmp_path):
+    """The behaviour reporter isn't vapor: a real 2-node net credits
+    VERIFIED votes into each node's trust store (via the consensus
+    batch path), and stopping persists the history to trust.db."""
+    async def go():
+        gdoc, pvs = single_val_genesis(2)
+        cfgs = [make_home(tmp_path, f"tn{i}", gdoc) for i in range(2)]
+        nodes = []
+        for i, cfg in enumerate(cfgs):
+            pv = pvs[i]
+            pv.key_path = cfg.base.resolve(cfg.base.priv_validator_key_file)
+            pv.state_path = cfg.base.resolve(
+                cfg.base.priv_validator_state_file)
+            pv.save_key()
+            nodes.append(Node.default_new_node(cfg))
+        await nodes[0].start()
+        await nodes[1].start()
+        try:
+            await nodes[1].switch.dial_peer(nodes[0].p2p_addr)
+            await asyncio.gather(
+                *(n.consensus_state.wait_for_height(3, timeout=60)
+                  for n in nodes))
+            for n in nodes:
+                rep = n.switch.reporter
+                assert rep is not None and rep.trust.size() >= 1
+                peer_id, metric = next(iter(rep.trust.metrics.items()))
+                assert metric.good > 0 or metric.num_intervals > 0
+                assert metric.trust_score() > 50
+        finally:
+            for n in nodes:
+                await n.stop()
+        trust_db = os.path.join(cfgs[0].base.home, "data", "trust.db")
+        assert os.path.exists(trust_db)
+        assert b"trusthistory" in open(trust_db, "rb").read()
+
+    run(go())

@@ -1,0 +1,145 @@
+"""UPnP IGD client against an in-process fake gateway
+(reference: p2p/upnp/ — SSDP + WANIPConnection SOAP)."""
+
+import asyncio
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from tendermint_tpu.p2p.upnp import IGD, UPnPError, discover
+
+_DESCRIPTION = """<?xml version="1.0"?>
+<root xmlns="urn:schemas-upnp-org:device-1-0">
+ <device>
+  <deviceType>urn:schemas-upnp-org:device:InternetGatewayDevice:1</deviceType>
+  <deviceList><device>
+   <serviceList>
+    <service>
+     <serviceType>urn:schemas-upnp-org:service:WANIPConnection:1</serviceType>
+     <controlURL>/ctl/IPConn</controlURL>
+    </service>
+   </serviceList>
+  </device></deviceList>
+ </device>
+</root>"""
+
+
+class _FakeIGDHandler(BaseHTTPRequestHandler):
+    mappings = {}
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def do_GET(self):
+        body = _DESCRIPTION.encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers["Content-Length"]))
+        action = self.headers.get("SOAPAction", "").strip('"').split("#")[-1]
+        if action == "GetExternalIPAddress":
+            inner = "<NewExternalIPAddress>203.0.113.7" \
+                    "</NewExternalIPAddress>"
+        elif action == "AddPortMapping":
+            import re
+
+            port = re.search(rb"<NewExternalPort>(\d+)<", body).group(1)
+            proto = re.search(rb"<NewProtocol>(\w+)<", body).group(1)
+            _FakeIGDHandler.mappings[(int(port), proto.decode())] = body
+            inner = ""
+        elif action == "DeletePortMapping":
+            import re
+
+            port = re.search(rb"<NewExternalPort>(\d+)<", body).group(1)
+            proto = re.search(rb"<NewProtocol>(\w+)<", body).group(1)
+            _FakeIGDHandler.mappings.pop((int(port), proto.decode()), None)
+            inner = ""
+        else:
+            self.send_response(500)
+            self.end_headers()
+            return
+        resp = (
+            '<?xml version="1.0"?><s:Envelope '
+            'xmlns:s="http://schemas.xmlsoap.org/soap/envelope/">'
+            f"<s:Body><u:{action}Response "
+            'xmlns:u="urn:schemas-upnp-org:service:WANIPConnection:1">'
+            f"{inner}</u:{action}Response></s:Body></s:Envelope>"
+        ).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(resp)))
+        self.end_headers()
+        self.wfile.write(resp)
+
+
+def _ssdp_responder(http_port: int):
+    """One-shot UDP responder standing in for the multicast gateway."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+
+    def serve():
+        data, peer = sock.recvfrom(4096)
+        assert b"M-SEARCH" in data
+        sock.sendto(
+            (
+                "HTTP/1.1 200 OK\r\n"
+                f"LOCATION: http://127.0.0.1:{http_port}/desc.xml\r\n"
+                "ST: urn:schemas-upnp-org:device:InternetGatewayDevice:1"
+                "\r\n\r\n"
+            ).encode(), peer)
+        sock.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    return port
+
+
+def test_discover_and_map_ports():
+    srv = HTTPServer(("127.0.0.1", 0), _FakeIGDHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        ssdp_port = _ssdp_responder(srv.server_port)
+
+        async def go():
+            igd = await discover(timeout=5.0,
+                                 ssdp_addr=("127.0.0.1", ssdp_port))
+            assert igd.control_url.endswith("/ctl/IPConn")
+            assert igd.external_ip() == "203.0.113.7"
+            igd.add_port_mapping(26656, 26656, "TCP", "tm-test")
+            assert (26656, "TCP") in _FakeIGDHandler.mappings
+            igd.delete_port_mapping(26656, "TCP")
+            assert (26656, "TCP") not in _FakeIGDHandler.mappings
+
+        asyncio.run(go())
+    finally:
+        srv.shutdown()
+
+
+def test_discover_timeout():
+    async def go():
+        # nothing listens on this port: clean UPnPError, no hang
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.bind(("127.0.0.1", 0))
+        silent_port = sock.getsockname()[1]
+        # keep socket open but never respond
+        try:
+            with pytest.raises(UPnPError, match="no UPnP gateway"):
+                await discover(timeout=0.3,
+                               ssdp_addr=("127.0.0.1", silent_port))
+        finally:
+            sock.close()
+
+    asyncio.run(go())
+
+
+def test_soap_error_surfaces():
+    igd = IGD(control_url="http://127.0.0.1:1/nothing",
+              service_type="urn:schemas-upnp-org:service:WANIPConnection:1",
+              local_ip="127.0.0.1")
+    with pytest.raises(UPnPError):
+        igd.external_ip()
