@@ -36,6 +36,13 @@ def worker():
     """Runs in a subprocess: do the measurement, print the JSON line."""
     import hashlib
 
+    # Persistent XLA cache: a retried attempt (or a rerun after a relay
+    # hiccup) skips the multi-minute kernel compiles.
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/tmp/tm_tpu_jax_cache")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                          "1")
+
     if "--cpu" in sys.argv:
         # The env var alone does NOT override this machine's axon
         # sitecustomize; the config update is what actually wins (same

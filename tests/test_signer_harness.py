@@ -1,0 +1,73 @@
+"""tm-signer-harness analogue: conformance suite against a live remote
+signer (reference: tools/tm-signer-harness/internal/test_harness.go)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.privval import FilePV
+from tendermint_tpu.privval.signer import SignerServer
+from tendermint_tpu.tools.signer_harness import (
+    HarnessFailure, run_harness,
+)
+
+CHAIN = "harness-chain"
+
+
+def test_conformant_signer_passes(tmp_path):
+    async def go():
+        pv = FilePV.generate(str(tmp_path / "key.json"),
+                             str(tmp_path / "state.json"))
+        server = SignerServer(pv, CHAIN)
+        harness = asyncio.create_task(run_harness(
+            "127.0.0.1:28981", CHAIN,
+            expected_key=pv.get_pub_key().bytes(), timeout=20,
+            log=lambda *a: None))
+        await asyncio.sleep(0.3)
+        dial = asyncio.create_task(
+            server.dial_and_serve("127.0.0.1", 28981))
+        rc = await asyncio.wait_for(harness, 30)
+        assert rc == 0
+        dial.cancel()
+
+    asyncio.run(go())
+
+
+def test_unsafe_signer_fails_double_sign_check(tmp_path):
+    """A signer WITHOUT double-sign protection must be rejected with
+    exit code 5 — the harness's entire reason to exist."""
+    from tendermint_tpu.types.priv_validator import MockPV
+
+    async def go():
+        pv = MockPV()  # no last-sign state: happily re-signs anything
+        server = SignerServer(pv, CHAIN)
+        harness = asyncio.create_task(run_harness(
+            "127.0.0.1:28982", CHAIN, timeout=20, log=lambda *a: None))
+        await asyncio.sleep(0.3)
+        dial = asyncio.create_task(
+            server.dial_and_serve("127.0.0.1", 28982))
+        with pytest.raises(HarnessFailure) as ei:
+            await asyncio.wait_for(harness, 30)
+        assert ei.value.code == 5
+        dial.cancel()
+
+    asyncio.run(go())
+
+
+def test_wrong_key_detected(tmp_path):
+    async def go():
+        pv = FilePV.generate(str(tmp_path / "key.json"),
+                             str(tmp_path / "state.json"))
+        server = SignerServer(pv, CHAIN)
+        harness = asyncio.create_task(run_harness(
+            "127.0.0.1:28983", CHAIN, expected_key=b"\x42" * 32,
+            timeout=20, log=lambda *a: None))
+        await asyncio.sleep(0.3)
+        dial = asyncio.create_task(
+            server.dial_and_serve("127.0.0.1", 28983))
+        with pytest.raises(HarnessFailure) as ei:
+            await asyncio.wait_for(harness, 30)
+        assert ei.value.code == 2
+        dial.cancel()
+
+    asyncio.run(go())
