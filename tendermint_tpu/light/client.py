@@ -103,15 +103,41 @@ class Client:
             return cached
         latest_trusted = self.store.latest()
         assert latest_trusted is not None
-        if height <= latest_trusted.height():
-            raise LightClientError(
-                f"height {height} below latest trusted "
-                f"{latest_trusted.height()}; backwards verification "
-                "unsupported for now")
+        if height < latest_trusted.height():
+            return await self._verify_backwards(height, now_ns)
         target = await self.primary.light_block(height)
         await self._verify_skipping(latest_trusted, target, now_ns)
         await self._detect_divergence(target, now_ns)
         return target
+
+    async def _verify_backwards(self, height: int,
+                                now_ns: int) -> LightBlock:
+        """Hash-chain walk DOWN from the nearest trusted block above
+        `height` (reference client.go:905 backwards + verifier.go:196):
+        each interim header must be the one the (already verified)
+        header above links to via last_block_id. No signature checks —
+        the linkage is the proof; the anchor must still be inside its
+        trusting period."""
+        from .verifier import verify_backwards
+
+        anchor_h = min(h for h in self.store.heights() if h > height)
+        cur = self.store.get(anchor_h)
+        if cur.time() + self.trust_options.period_ns <= now_ns:
+            raise LightClientError(
+                f"anchor header {anchor_h} outside trusting period")
+        while cur.height() > height:
+            interim = await self.primary.light_block(cur.height() - 1)
+            try:
+                interim.validate_basic(self.chain_id)
+                verify_backwards(interim.signed_header.header,
+                                 cur.signed_header.header)
+            except (LightClientError, ValueError) as e:
+                raise LightClientError(
+                    f"backwards verification failed at height "
+                    f"{cur.height() - 1}: {e}") from e
+            self.store.save(interim)
+            cur = interim
+        return cur
 
     async def update(self, now_ns: int | None = None) -> LightBlock | None:
         """Verify the primary's latest header

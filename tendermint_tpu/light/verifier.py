@@ -91,6 +91,27 @@ def verify_non_adjacent(chain_id: str, trusted: LightBlock,
         raise VerificationFailedError(f"invalid commit: {e}") from e
 
 
+def verify_backwards(untrusted_header, trusted_header) -> None:
+    """Hash-chain verification of an OLDER header against a newer
+    trusted one (reference: light/verifier.go:196 VerifyBackwards):
+    the trusted header's last_block_id must be the hash of the older
+    header — no signatures needed, the chain linkage is the proof."""
+    untrusted_header.validate_basic()
+    if untrusted_header.chain_id != trusted_header.chain_id:
+        raise VerificationFailedError(
+            f"older header from a different chain "
+            f"({untrusted_header.chain_id!r} != "
+            f"{trusted_header.chain_id!r})")
+    if untrusted_header.time >= trusted_header.time:
+        raise VerificationFailedError(
+            "older header time not before trusted header time")
+    if trusted_header.last_block_id is None or \
+            untrusted_header.hash() != trusted_header.last_block_id.hash:
+        raise VerificationFailedError(
+            "older header hash does not match trusted header's "
+            "last_block_id")
+
+
 def verify(chain_id: str, trusted: LightBlock, untrusted: LightBlock,
            trusting_period_ns: int, now_ns: int,
            trust_level: Fraction = DEFAULT_TRUST_LEVEL) -> None:

@@ -13,8 +13,8 @@ from tendermint_tpu.light import (
     SignedHeader, TrustOptions, verify_adjacent, verify_non_adjacent,
 )
 from tendermint_tpu.light.errors import (
-    NewValSetCantBeTrustedError, OutsideTrustingPeriodError,
-    VerificationFailedError,
+    LightClientError, NewValSetCantBeTrustedError,
+    OutsideTrustingPeriodError, VerificationFailedError,
 )
 from tendermint_tpu.light.provider import BlockNotFoundError, Provider
 from tendermint_tpu.types.block import BlockID, Header, PartSetHeader
@@ -40,13 +40,14 @@ class LightChain:
         self.blocks: dict[int, LightBlock] = {}
         sets = {h: _valset(valset_for(h))
                 for h in range(1, n_heights + 2)}
+        prev_bid = None
         for h in range(1, n_heights + 1):
             vals, pvs = sets[h]
             nvals, _ = sets[h + 1]
             header = Header(
                 version_block=11, version_app=0, chain_id=CHAIN_ID,
                 height=h, time=T0 + h * 1_000_000_000,
-                last_block_id=None,
+                last_block_id=prev_bid,
                 last_commit_hash=b"\x01" * 32, data_hash=b"\x02" * 32,
                 validators_hash=vals.hash(),
                 next_validators_hash=nvals.hash(),
@@ -59,6 +60,7 @@ class LightChain:
             commit = sign_commit(vals, pvs, CHAIN_ID, h, 0, bid,
                                  header.time + 1)
             self.blocks[h] = LightBlock(SignedHeader(header, commit), vals)
+            prev_bid = bid
 
     def provider(self, tamper_height=None):
         chain = self
@@ -216,3 +218,46 @@ def test_light_client_against_live_node():
             await node.stop()
 
     run(go())
+
+
+def test_backwards_verification():
+    """Requesting a height BELOW the latest trusted walks the hash
+    chain down from the nearest trusted anchor (reference
+    client.go:905 backwards, verifier.go:196 VerifyBackwards)."""
+    import dataclasses
+
+    from tendermint_tpu.light.verifier import verify_backwards
+
+    chain = LightChain(8)
+    cl = _client(chain)
+    run(cl.verify_light_block_at_height(8))
+    assert cl.store.get(3) is None  # skipped straight to 8
+    lb3 = run(cl.verify_light_block_at_height(3))
+    assert lb3.height() == 3
+    assert lb3.hash() == chain.blocks[3].hash()
+    # interim headers were persisted on the way down
+    for h in range(3, 8):
+        assert cl.store.get(h) is not None
+
+    # unit: a forged interim header breaks the hash link
+    good = chain.blocks[5].signed_header.header
+    trusted = chain.blocks[6].signed_header.header
+    verify_backwards(good, trusted)
+    forged = dataclasses.replace(good, app_hash=b"\xee" * 32)
+    with pytest.raises(LightClientError):
+        verify_backwards(forged, trusted)
+    # and non-decreasing time is rejected
+    late = dataclasses.replace(good, time=trusted.time + 1)
+    with pytest.raises(LightClientError):
+        verify_backwards(late, trusted)
+
+
+def test_backwards_rejects_tampering_primary():
+    """A primary serving a forged interim header during the walk-down
+    fails verification instead of polluting the store."""
+    chain = LightChain(8)
+    cl = _client(chain, primary=chain.provider(tamper_height=5))
+    run(cl.verify_light_block_at_height(8))
+    with pytest.raises(LightClientError, match="backwards"):
+        run(cl.verify_light_block_at_height(3))
+    assert cl.store.get(5) is None and cl.store.get(3) is None
