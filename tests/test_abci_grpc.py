@@ -74,13 +74,15 @@ def test_grpc_app_errors_are_rpc_errors_not_dead_server():
     run(go())
 
 
+@pytest.mark.parametrize("fixture", ["ex1", "ex2"])
 @pytest.mark.parametrize("transport", ["socket", "grpc"])
-def test_abci_cli_golden(transport, tmp_path):
+def test_abci_cli_golden(transport, fixture, tmp_path):
     """The reference's abci/tests/test_cli flow: run the kvstore app
     server, pipe the golden script through `abci-cli batch`, diff the
     output — on BOTH transports (they must be indistinguishable above
     the framing)."""
-    port = 29358 if transport == "socket" else 29359
+    port = (29358 if transport == "socket" else 29359) + \
+        (10 if fixture == "ex2" else 0)
     env = dict(os.environ)
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo_root
@@ -94,13 +96,15 @@ def test_abci_cli_golden(transport, tmp_path):
         while time.monotonic() < deadline:
             if srv.stdout.readline().startswith(b"serving"):
                 break
-        script = open(os.path.join(GOLDEN_DIR, "ex1.abci"), "rb").read()
+        script = open(os.path.join(
+            GOLDEN_DIR, f"{fixture}.abci"), "rb").read()
         out = subprocess.run(
             [sys.executable, "-m", "tendermint_tpu.abci.cli", "batch",
              "--address", f"tcp://127.0.0.1:{port}", "--abci", transport],
             input=script, capture_output=True, env=env, timeout=60)
         assert out.returncode == 0, out.stderr
-        golden = open(os.path.join(GOLDEN_DIR, "ex1.abci.out"), "rb").read()
+        golden = open(os.path.join(
+            GOLDEN_DIR, f"{fixture}.abci.out"), "rb").read()
         assert out.stdout.decode() == golden.decode()
     finally:
         srv.terminate()

@@ -1,0 +1,105 @@
+"""Crypto micro-benchmarks (reference: crypto/internal/benchmarking/
+bench.go + per-keytype bench_test.go files).
+
+Keygen / sign / verify for every key type, host oracles and device
+batch paths, printed as one table. Run on CPU for sanity or on the
+real chip for numbers:
+
+    python tools/crypto_bench.py [--cpu] [--batch N]
+"""
+
+import hashlib
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timeit(f, reps):
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        f()
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    if "--cpu" in sys.argv:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    batch = 1024
+    for i, a in enumerate(sys.argv):
+        if a == "--batch":
+            batch = int(sys.argv[i + 1])
+
+    rows = []
+
+    # -- ed25519 --
+    from tendermint_tpu.crypto import ed25519
+
+    priv = ed25519.Ed25519PrivKey.generate()
+    pub = priv.pub_key()
+    msg = b"bench message for signing"
+    sig = priv.sign(msg)
+    rows.append(("ed25519 keygen", timeit(
+        ed25519.Ed25519PrivKey.generate, 200)))
+    rows.append(("ed25519 sign", timeit(lambda: priv.sign(msg), 200)))
+    rows.append(("ed25519 verify (host)", timeit(
+        lambda: pub.verify_signature(msg, sig), 200)))
+
+    # -- sr25519 --
+    from tendermint_tpu.crypto import sr25519_ref as sr
+
+    mini = hashlib.sha256(b"bench").digest()
+    spub = sr.public_key_from_mini(mini)
+    ssig = sr.sign(mini, msg)
+    rows.append(("sr25519 sign (host)", timeit(
+        lambda: sr.sign(mini, msg), 5)))
+    rows.append(("sr25519 verify (host)", timeit(
+        lambda: sr.verify(spub, msg, ssig), 5)))
+
+    # -- secp256k1 --
+    from tendermint_tpu.crypto import secp256k1 as secp
+
+    kpriv = secp.Secp256k1PrivKey.generate()
+    kpub = kpriv.pub_key()
+    ksig = kpriv.sign(msg)
+    rows.append(("secp256k1 sign", timeit(lambda: kpriv.sign(msg), 20)))
+    rows.append(("secp256k1 verify", timeit(
+        lambda: kpub.verify_signature(msg, ksig), 20)))
+
+    # -- batched device paths --
+    from tendermint_tpu.crypto.tpu import verify as tv
+    from tendermint_tpu.crypto.tpu.sr_verify import verify_batch_sr
+
+    seeds = [hashlib.sha256(b"b%d" % i).digest() for i in range(batch)]
+    from tendermint_tpu.crypto import ed25519_ref as ref
+
+    pubs = [ref.public_key_from_seed(s) for s in seeds]
+    msgs = [b"bench %d" % i for i in range(batch)]
+    sigs = [ref.sign(s, m) for s, m in zip(seeds, msgs)]
+    tv.verify_batch(pubs, msgs, sigs)  # compile
+    t = timeit(lambda: tv.verify_batch(pubs, msgs, sigs), 3)
+    rows.append((f"ed25519 device batch x{batch} (per sig)", t / batch))
+
+    n_sr = min(batch, 256)
+    minis = [hashlib.sha256(b"s%d" % i).digest() for i in range(n_sr)]
+    spubs = [sr.public_key_from_mini(m) for m in minis]
+    ssigs = [sr.sign(m, mm) for m, mm in zip(minis, msgs[:n_sr])]
+    verify_batch_sr(spubs, msgs[:n_sr], ssigs)  # compile
+    t = timeit(lambda: verify_batch_sr(spubs, msgs[:n_sr], ssigs), 3)
+    rows.append((f"sr25519 device batch x{n_sr} (per sig)", t / n_sr))
+
+    import jax
+
+    print(f"device: {jax.devices()[0]}")
+    width = max(len(r[0]) for r in rows)
+    for name, secs in rows:
+        print(f"{name:<{width}}  {secs * 1e6:>12.1f} us")
+
+
+if __name__ == "__main__":
+    main()
