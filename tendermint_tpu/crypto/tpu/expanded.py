@@ -98,8 +98,17 @@ def _builder():
     return build
 
 
+# Windows processed per fori_loop iteration (69 must divide evenly:
+# 1, 3, or 23). >1 unrolls the loop body, giving XLA ILP across
+# windows at the cost of a bigger program — an A/B knob for
+# tools/sweep_thresholds.py on the real chip (task: the 69-iteration
+# serial loop is the latency suspect). Default 1 = round-2 behavior.
+WINDOWS_PER_ITER = int(__import__("os").environ.get(
+    "TM_TPU_WINDOWS_PER_ITER", "1"))
+
+
 @functools.cache
-def _xkernel():
+def _xkernel(wpi: int = WINDOWS_PER_ITER):
     import jax
     import jax.numpy as jnp
 
@@ -107,6 +116,8 @@ def _xkernel():
     from . import field as fe
     from . import scalar as sc
     from . import sha512 as sh
+
+    assert _WINDOWS % wpi == 0, "windows-per-iter must divide 69"
 
     @jax.jit
     def kernel(idx, ab, sb, msg, nblocks, s_ok, key_ok, atab, btab):
@@ -152,8 +163,7 @@ def _xkernel():
         sel = jnp.transpose(sel.reshape(_WINDOWS, n, _ROW), (0, 2, 1))
         sel = sel[:, : 4 * 22, :]  # (69, 88, N)
 
-        def body(w, accs):
-            acc_a, acc_b = accs
+        def one_window(w, acc_a, acc_b):
             e = jax.lax.dynamic_index_in_dim(sel, w, 0, keepdims=False)
             neg = jax.lax.dynamic_index_in_dim(dsign, w, 0, keepdims=False)
             # -(x, y, z, t) = (-x, y, z, -t), applied per digit sign.
@@ -164,10 +174,16 @@ def _xkernel():
             bw = jax.lax.dynamic_index_in_dim(btab, w, 0, keepdims=False)
             bx, by, bt = ed.select_const(bw, ds)
             acc_b = ed.add_z1(acc_b, bx, by, bt)
+            return acc_a, acc_b
+
+        def body(i, accs):
+            acc_a, acc_b = accs
+            for j in range(wpi):  # unrolled in the traced program
+                acc_a, acc_b = one_window(i * wpi + j, acc_a, acc_b)
             return (acc_a, acc_b)
 
         acc_a, acc_b = jax.lax.fori_loop(
-            0, _WINDOWS, body, (ed.identity(n), ed.identity(n))
+            0, _WINDOWS // wpi, body, (ed.identity(n), ed.identity(n))
         )
         v = ed.add(ed.add(acc_a, acc_b), neg_r)
         v = ed.double(ed.double(ed.double(v)))
@@ -300,7 +316,7 @@ class ExpandedKeys:
                 for k, v in packed.items()
             }
             btab = jax.device_put(btab, repl_s)
-        return _xkernel()(
+        return _xkernel(WINDOWS_PER_ITER)(
             idx=idx,
             key_ok=self.key_ok,
             atab=self.tables,

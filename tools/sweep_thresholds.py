@@ -100,6 +100,26 @@ def main():
               f"{e * 1e3:.2f} ms, host {host_per_sig * n * 1e3:.2f} ms",
               flush=True)
 
+    # Kernel-shape A/B: windows per fori_loop iteration (69 = 3 x 23).
+    # Unrolling trades program size for cross-window ILP; measure at
+    # the headline batch.
+    n_ab = 10240 if 10240 in SIZES and not cpu else max(
+        s for s in SIZES if s <= 1024)
+    idx_ab = list(range(n_ab))
+    ab_res = {}
+    for wpi in (1, 3, 23):
+        ex.WINDOWS_PER_ITER = wpi
+        try:
+            exp.verify(idx_ab, msgs[:n_ab], sigs[:n_ab])  # compile
+            t = p50(lambda: exp.verify(idx_ab, msgs[:n_ab], sigs[:n_ab]),
+                    reps=3)
+            ab_res[wpi] = round(t * 1e3, 3)
+            print(f"expanded wpi={wpi} @ {n_ab}: {t * 1e3:.2f} ms",
+                  flush=True)
+        finally:
+            ex.WINDOWS_PER_ITER = 1
+    results["ed25519"]["windows_per_iter_ms"] = ab_res
+
     # sr25519
     from tendermint_tpu.crypto import sr25519_ref as sr
     from tendermint_tpu.crypto.tpu.sr_verify import verify_batch_sr
