@@ -33,6 +33,7 @@ class BaseConfig:
 class RPCConfig:
     laddr: str = "tcp://127.0.0.1:26657"
     grpc_laddr: str = ""  # gRPC broadcast API (reference rpc/grpc)
+    unsafe: bool = False  # expose unsafe_* / dial_* routes
     max_open_connections: int = 900
     max_subscription_clients: int = 100
     max_subscriptions_per_client: int = 5

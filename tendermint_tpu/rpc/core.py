@@ -125,6 +125,14 @@ class Environment:
             "tx": self.tx,
             "tx_search": self.tx_search,
             "broadcast_evidence": self.broadcast_evidence,
+            "check_tx": self.check_tx,
+            # unsafe routes (reference routes.go AddUnsafeRoutes;
+            # exposed only with rpc.unsafe = true)
+            **({
+                "unsafe_flush_mempool": self.unsafe_flush_mempool,
+                "dial_seeds": self.dial_seeds,
+                "dial_peers": self.dial_peers,
+            } if getattr(self.node.config.rpc, "unsafe", False) else {}),
         }
 
     def ws_routes(self) -> dict:
@@ -399,6 +407,40 @@ class Environment:
             raise RPCError(-32603, f"tx rejected: {e}") from e
         return {"code": res.code, "data": _b64(res.data or b""),
                 "log": res.log, "hash": _hex(tmhash.sum256(raw))}
+
+    async def check_tx(self, ctx, tx="") -> dict:
+        """Run CheckTx against the app WITHOUT adding to the mempool
+        (reference: rpc/core/mempool.go CheckTx)."""
+        from ..abci.types import RequestCheckTx
+
+        raw = base64.b64decode(tx)
+        res = await self.node.proxy_app.mempool.check_tx(
+            RequestCheckTx(raw))
+        return {"code": res.code, "data": _b64(res.data or b""),
+                "log": res.log, "gas_wanted": str(res.gas_wanted),
+                "gas_used": str(res.gas_used)}
+
+    async def unsafe_flush_mempool(self, ctx) -> dict:
+        """reference: rpc/core/mempool.go UnsafeFlushMempool."""
+        await self.node.mempool.flush()
+        return {}
+
+    async def dial_seeds(self, ctx, seeds=()) -> dict:
+        """reference: rpc/core/net.go UnsafeDialSeeds."""
+        if not seeds:
+            raise RPCError(-32602, "no seeds provided")
+        await self.node.switch.dial_peers_async(list(seeds))
+        return {"log": f"dialing seeds in progress. see /net_info "
+                       f"for details ({len(seeds)})"}
+
+    async def dial_peers(self, ctx, peers=(), persistent=False) -> dict:
+        """reference: rpc/core/net.go UnsafeDialPeers."""
+        if not peers:
+            raise RPCError(-32602, "no peers provided")
+        if persistent:
+            self.node.switch.add_persistent_peers(list(peers))
+        await self.node.switch.dial_peers_async(list(peers))
+        return {"log": f"dialing peers in progress ({len(peers)})"}
 
     async def broadcast_tx_commit(self, ctx, tx="") -> dict:
         """CheckTx, then wait for the tx to land in a block

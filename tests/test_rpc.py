@@ -203,3 +203,62 @@ def test_ws_subscription(tmp_path):
             await node.stop()
 
     run(go())
+
+
+def test_check_tx_and_unsafe_routes(tmp_path):
+    """check_tx runs the app WITHOUT mempool admission; unsafe routes
+    appear only with rpc.unsafe = true (reference rpc/core/routes.go
+    AddUnsafeRoutes)."""
+    import base64
+
+    from test_node import make_home, single_val_genesis
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.rpc.jsonrpc import HTTPClient, RPCError
+
+    async def go():
+        gdoc, pvs = single_val_genesis()
+        cfg = make_home(tmp_path, "n0", gdoc)
+        cfg.rpc.unsafe = True
+        pv = pvs[0]
+        pv.key_path = cfg.base.resolve(cfg.base.priv_validator_key_file)
+        pv.state_path = cfg.base.resolve(
+            cfg.base.priv_validator_state_file)
+        pv.save_key()
+        node = Node.default_new_node(cfg)
+        await node.start()
+        try:
+            await node.consensus_state.wait_for_height(1, timeout=60)
+            cli = HTTPClient("127.0.0.1", node.rpc_port, timeout=5)
+            res = await cli.call(
+                "check_tx", tx=base64.b64encode(b"ct=1").decode())
+            assert res["code"] == 0
+            # not admitted to the mempool
+            un = await cli.call("num_unconfirmed_txs")
+            assert int(un["total"]) == 0
+            # flush works (and exists, because unsafe=true)
+            await cli.call(
+                "broadcast_tx_async",
+                tx=base64.b64encode(b"will-be-flushed=1").decode())
+            await cli.call("unsafe_flush_mempool")
+            un = await cli.call("num_unconfirmed_txs")
+            assert int(un["total"]) == 0
+            # dial_* validate their inputs
+            import pytest as _pytest
+
+            with _pytest.raises(RPCError):
+                await cli.call("dial_seeds")
+        finally:
+            await node.stop()
+
+        # without unsafe, the routes don't exist
+        cfg2 = make_home(tmp_path, "n1", gdoc)
+        node2 = Node.default_new_node(cfg2)
+        await node2.start()
+        try:
+            cli2 = HTTPClient("127.0.0.1", node2.rpc_port, timeout=5)
+            with _pytest.raises(RPCError, match="method|not found|unknown"):
+                await cli2.call("unsafe_flush_mempool")
+        finally:
+            await node2.stop()
+
+    run(go())
