@@ -112,6 +112,9 @@ class BlockchainReactor(Reactor):
 
     async def start(self) -> None:
         if self.fast_sync and self._task is None:
+            from ..libs.metrics import consensus_metrics
+
+            consensus_metrics().fast_syncing.set(1)
             self._task = asyncio.get_running_loop().create_task(
                 self._pool_routine(), name="blockchain-pool")
 
@@ -212,6 +215,9 @@ class BlockchainReactor(Reactor):
                                     "(%d blocks)", self.pool.height - 1,
                                     self.blocks_synced)
                         self.synced.set()
+                        from ..libs.metrics import consensus_metrics
+
+                        consensus_metrics().fast_syncing.set(0)
                         if self.consensus_reactor is not None:
                             await self.consensus_reactor.\
                                 switch_to_consensus(self.state)

@@ -669,10 +669,30 @@ class ConsensusState(Service):
         vals = self.rs.validators
         met.validators.set(len(vals))
         met.validators_power.set(vals.total_voting_power())
-        missing = sum(
-            1 for i in range(len(vals)) if precommits.get_by_index(i) is None
-        )
+        missing = missing_power = 0
+        for i in range(len(vals)):
+            if precommits.get_by_index(i) is None:
+                missing += 1
+                missing_power += vals.validators[i].voting_power
         met.missing_validators.set(missing)
+        met.missing_validators_power.set(missing_power)
+        # evidence in THIS block tallies byzantine signers
+        byz = {e.vote_a.validator_address
+               for e in block.evidence.evidence
+               if hasattr(e, "vote_a")}
+        if byz:
+            met.byzantine_validators.set(len(byz))
+            met.byzantine_validators_power.set(sum(
+                v.voting_power for v in vals.validators
+                if v.address in byz))
+        if self.priv_validator_address is not None and \
+                vals.has_address(self.priv_validator_address):
+            idx, own = vals.get_by_address(self.priv_validator_address)
+            met.validator_power.set(own.voting_power)
+            if precommits.get_by_index(idx) is not None:
+                met.validator_last_signed_height.set(block.header.height)
+            else:
+                met.validator_missed_blocks.inc()
         ntx = len(block.data.txs)
         met.num_txs.set(ntx)
         met.total_txs.inc(ntx)

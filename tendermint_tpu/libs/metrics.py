@@ -189,8 +189,33 @@ class ConsensusMetrics:
     missing_validators: Gauge = field(default_factory=lambda: DEFAULT.gauge(
         "missing_validators", "Validators absent from the last commit.",
         "consensus"))
+    missing_validators_power: Gauge = field(
+        default_factory=lambda: DEFAULT.gauge(
+            "missing_validators_power",
+            "Voting power of validators absent from the last commit.",
+            "consensus"))
     byzantine_validators: Gauge = field(default_factory=lambda: DEFAULT.gauge(
         "byzantine_validators", "Validators that equivocated.", "consensus"))
+    byzantine_validators_power: Gauge = field(
+        default_factory=lambda: DEFAULT.gauge(
+            "byzantine_validators_power",
+            "Voting power of validators that equivocated.", "consensus"))
+    validator_power: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "validator_power", "This node's voting power (0 if not a "
+        "validator).", "consensus"))
+    validator_last_signed_height: Gauge = field(
+        default_factory=lambda: DEFAULT.gauge(
+            "validator_last_signed_height",
+            "Last height this node's precommit made a commit.",
+            "consensus"))
+    validator_missed_blocks: Counter = field(
+        default_factory=lambda: DEFAULT.counter(
+            "validator_missed_blocks",
+            "Commits missing this node's precommit.", "consensus"))
+    fast_syncing: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "fast_syncing", "1 while fast sync is running.", "consensus"))
+    state_syncing: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "state_syncing", "1 while state sync is running.", "consensus"))
     num_txs: Gauge = field(default_factory=lambda: DEFAULT.gauge(
         "num_txs", "Transactions in the latest block.", "consensus"))
     block_size_bytes: Gauge = field(default_factory=lambda: DEFAULT.gauge(

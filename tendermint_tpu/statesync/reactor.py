@@ -61,12 +61,18 @@ class StateSyncReactor(Reactor):
     async def sync(self):
         """Discover + restore; returns (state, commit)
         (reference reactor.go:480 Sync via syncer.SyncAny)."""
+        from ..libs.metrics import consensus_metrics
+
         assert self.syncer is not None, "no state provider wired"
         sw = self.switch
         if sw is not None:
             sw.broadcast(SNAPSHOT_CHANNEL,
                          encode_ss_msg(SnapshotsRequestMessage()))
-        return await self.syncer.sync_any()
+        consensus_metrics().state_syncing.set(1)
+        try:
+            return await self.syncer.sync_any()
+        finally:
+            consensus_metrics().state_syncing.set(0)
 
     def _request_snapshots(self) -> None:
         sw = self.switch

@@ -121,3 +121,35 @@ def test_rpc_metrics_route():
         srv.close()
 
     asyncio.run(run())
+
+
+def test_reference_catalog_metrics_present():
+    """Every metric in the reference's docs/nodes/metrics.md catalog
+    has an equivalent in our registries (naming: <ns>_<name>)."""
+    from tendermint_tpu.libs.metrics import (
+        DEFAULT, consensus_metrics, mempool_metrics, p2p_metrics,
+        state_metrics,
+    )
+
+    consensus_metrics(), mempool_metrics(), p2p_metrics(), state_metrics()
+    text = DEFAULT.render_text()
+    for want in (
+        "consensus_height", "consensus_validators",
+        "consensus_validators_power", "consensus_validator_power",
+        "consensus_validator_last_signed_height",
+        "consensus_validator_missed_blocks",
+        "consensus_missing_validators",
+        "consensus_missing_validators_power",
+        "consensus_byzantine_validators",
+        "consensus_byzantine_validators_power",
+        "consensus_block_interval_seconds", "consensus_rounds",
+        "consensus_num_txs", "consensus_total_txs",
+        "consensus_fast_syncing", "consensus_state_syncing",
+        "consensus_block_size_bytes",
+        "p2p_peers", "p2p_peer_receive_bytes_total",
+        "p2p_peer_send_bytes_total", "p2p_pending_send_bytes",
+        "mempool_size", "mempool_tx_size_bytes", "mempool_failed_txs",
+        "mempool_recheck_times",
+        "state_block_processing_seconds",
+    ):
+        assert want in text, f"{want} missing from /metrics"
