@@ -1,0 +1,8 @@
+#!/bin/bash
+# Run the full TPU measurement sequence once the relay is back.
+# (See docs/PERF_NOTES.md for what each number means.)
+set -x
+cd "$(dirname "$0")/.."
+python bench.py | tee /tmp/bench_r03_latest.json
+python tools/sweep_thresholds.py --out docs/THRESHOLDS.md
+python tools/crypto_bench.py
