@@ -97,6 +97,14 @@ def cmd_start(args) -> int:
 
     async def run():
         node = Node.default_new_node(cfg)
+        # Maverick mode (reference: test/maverick — a node binary with
+        # pluggable misbehaviors): --misbehavior double-prevote@H
+        if args.misbehavior:
+            from ..consensus.misbehavior import MISBEHAVIORS
+
+            for spec in args.misbehavior.split(","):
+                name, _, h = spec.partition("@")
+                node.misbehaviors[int(h)] = MISBEHAVIORS[name]()
         await node.start()
         logging.getLogger("node").info(
             "node %s started: p2p %s rpc port %s",
@@ -347,6 +355,9 @@ def build_parser() -> argparse.ArgumentParser:
                     default="")
     sp.add_argument("--fast_sync", choices=("true", "false"), default=None)
     sp.add_argument("--log_level", default="info")
+    sp.add_argument("--misbehavior", default="",
+                    help="maverick mode: NAME@HEIGHT[,NAME@HEIGHT...] "
+                         "(e.g. double-prevote@3)")
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("testnet", help="generate a local testnet")

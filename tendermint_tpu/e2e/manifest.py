@@ -39,6 +39,26 @@ class Perturbation:
 
 
 @dataclass
+class Misbehavior:
+    """A maverick node (reference: maverick selectable via the e2e
+    manifest): `spec` is NAME@HEIGHT[,NAME@HEIGHT...], passed to the
+    node's --misbehavior flag."""
+
+    node: int
+    spec: str
+
+    def validate(self, n_nodes: int) -> None:
+        from ..consensus.misbehavior import MISBEHAVIORS
+
+        if not 0 <= self.node < n_nodes:
+            raise ValueError(f"misbehavior node {self.node} out of range")
+        for part in self.spec.split(","):
+            name, sep, h = part.partition("@")
+            if name not in MISBEHAVIORS or not sep or not h.isdigit():
+                raise ValueError(f"bad misbehavior spec {part!r}")
+
+
+@dataclass
 class Manifest:
     nodes: int = 4
     chain_id: str = ""
@@ -46,6 +66,7 @@ class Manifest:
     load_tx_rate: float = 0.0
     timeout_commit_ms: int = 200
     perturbations: list[Perturbation] = field(default_factory=list)
+    misbehaviors: list[Misbehavior] = field(default_factory=list)
 
     def validate(self) -> None:
         if self.nodes < 1:
@@ -54,6 +75,8 @@ class Manifest:
             raise ValueError("wait_height must be >= 1")
         for p in self.perturbations:
             p.validate(self.nodes)
+        for mb in self.misbehaviors:
+            mb.validate(self.nodes)
 
     @classmethod
     def load(cls, path: str) -> "Manifest":
@@ -65,8 +88,9 @@ class Manifest:
 
     _KEYS = frozenset({"nodes", "chain_id", "wait_height",
                        "load_tx_rate", "timeout_commit_ms",
-                       "perturbations"})
+                       "perturbations", "misbehaviors"})
     _PERTURB_KEYS = frozenset({"node", "op", "at_height", "duration"})
+    _MISBEHAVIOR_KEYS = frozenset({"node", "spec"})
 
     @classmethod
     def from_dict(cls, d: dict) -> "Manifest":
@@ -80,6 +104,11 @@ class Manifest:
             if bad:
                 raise ValueError(
                     f"unknown perturbation keys: {sorted(bad)}")
+        for mb in d.get("misbehaviors", []):
+            bad = set(mb) - cls._MISBEHAVIOR_KEYS
+            if bad:
+                raise ValueError(
+                    f"unknown misbehavior keys: {sorted(bad)}")
         m = cls(
             nodes=int(d.get("nodes", 4)),
             chain_id=d.get("chain_id", ""),
@@ -94,6 +123,10 @@ class Manifest:
                     duration=float(p.get("duration", 3.0)),
                 )
                 for p in d.get("perturbations", [])
+            ],
+            misbehaviors=[
+                Misbehavior(node=int(mb["node"]), spec=mb["spec"])
+                for mb in d.get("misbehaviors", [])
             ],
         )
         m.validate()

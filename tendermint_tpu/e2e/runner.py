@@ -32,10 +32,12 @@ BASE_PORT = 27100
 
 
 class NodeProc:
-    def __init__(self, index: int, home: str, rpc_port: int):
+    def __init__(self, index: int, home: str, rpc_port: int,
+                 misbehavior: str = ""):
         self.index = index
         self.home = home
         self.rpc_port = rpc_port
+        self.misbehavior = misbehavior
         self.proc: subprocess.Popen | None = None
         self.log_path = os.path.join(home, "node.log")
 
@@ -46,9 +48,12 @@ class NodeProc:
             os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
         env.setdefault("JAX_PLATFORMS", "cpu")
+        cmd = [sys.executable, "-m", "tendermint_tpu.cmd",
+               "--home", self.home, "start"]
+        if self.misbehavior:
+            cmd += ["--misbehavior", self.misbehavior]
         self.proc = subprocess.Popen(
-            [sys.executable, "-m", "tendermint_tpu.cmd",
-             "--home", self.home, "start"],
+            cmd,
             stdout=open(self.log_path, "ab"),
             stderr=subprocess.STDOUT, env=env)
 
@@ -116,8 +121,10 @@ class Runner:
             cfg.base.fast_sync = False
             cfg.consensus.timeout_commit_ms = self.m.timeout_commit_ms
             cfg.save(cfg_path)
+            mb = ",".join(m.spec for m in self.m.misbehaviors
+                          if m.node == i)
             self.nodes.append(NodeProc(
-                i, home, self.base_port + 1000 + i))
+                i, home, self.base_port + 1000 + i, misbehavior=mb))
 
     def start(self) -> None:
         for node in self.nodes:
@@ -240,17 +247,23 @@ class Runner:
 
     async def check(self) -> dict:
         """All nodes at wait_height agree on every block hash — the
-        no-fork assertion (reference test/e2e/tests/block_test.go)."""
+        no-fork assertion (reference test/e2e/tests/block_test.go) —
+        and committed evidence is counted (evidence_test.go)."""
         h = self.m.wait_height
         hashes: dict[int, set] = {}
+        evidence = 0
         for node in self.nodes:
             for height in range(1, h + 1):
                 b = await self._rpc(node, "block", height=height)
                 hashes.setdefault(height, set()).add(
                     b["block_id"]["hash"])
+                if node.index == 0:
+                    evidence += len(
+                        b["block"]["evidence"]["evidence"])
         forks = {h_: v for h_, v in hashes.items() if len(v) > 1}
         assert not forks, f"FORK detected: {forks}"
-        return {"ok": True, "height": h, "nodes": len(self.nodes)}
+        return {"ok": True, "height": h, "nodes": len(self.nodes),
+                "evidence_committed": evidence}
 
     def cleanup(self) -> None:
         for node in self.nodes:

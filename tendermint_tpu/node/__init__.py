@@ -101,6 +101,9 @@ class Node(Service):
         self.state_provider_factory = state_provider_factory
         self.in_memory = in_memory
         self._built = False
+        # height -> consensus.misbehavior.Misbehavior, applied to the
+        # state machine at build time (maverick mode; set before start)
+        self.misbehaviors: dict = {}
 
     @classmethod
     def default_new_node(cls, config: Config) -> "Node":
@@ -148,6 +151,7 @@ class Node(Service):
             mempool=self.mempool, evpool=self.evpool,
             wal=None if self.in_memory else WAL(wal_path),
             event_bus=self.event_bus)
+        self.consensus_state.misbehaviors.update(self.misbehaviors)
         if self.priv_validator is not None:
             self.consensus_state.set_priv_validator(self.priv_validator)
 
