@@ -112,6 +112,11 @@ class DoublePropose(Misbehavior):
     async def enter_propose(self, cs, height: int, round_: int) -> bool:
         if not cs._is_proposer() or cs.priv_validator is None:
             return False
+        # One-shot: a proposer that split the net EVERY round of this
+        # height would livelock it (no round ever forms a polka while
+        # half the peers hold each proposal). One equivocation is the
+        # attack; later rounds proceed honestly and consensus recovers.
+        cs.misbehaviors.pop(height, None)
         rs = cs.rs
         from ..types.block import Commit, NIL_BLOCK_ID
 

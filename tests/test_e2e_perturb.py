@@ -91,7 +91,33 @@ def test_maverick_in_subprocess_net(tmp_path):
     })
     runner = Runner(m, str(tmp_path / "net"), base_port=27500,
                     log=lambda s: None)
-    report = asyncio.run(asyncio.wait_for(runner.run(), timeout=540))
-    assert report["ok"]
-    assert report["evidence_committed"] >= 1, \
-        "maverick equivocation never became committed evidence"
+
+    async def go():
+        import time as _t
+
+        try:
+            runner.setup()
+            runner.start()
+            await runner.wait_all_height(m.wait_height, timeout=420)
+            report = await runner.check()
+            assert report["ok"]
+            # Evidence can land a few heights after the equivocation;
+            # keep polling new blocks until it shows (the net is still
+            # running).
+            deadline = _t.monotonic() + 60
+            total = report["evidence_committed"]
+            while total == 0 and _t.monotonic() < deadline:
+                h = await runner.height_of(runner.nodes[0])
+                for height in range(1, h + 1):
+                    b = await runner._rpc(runner.nodes[0], "block",
+                                          height=height)
+                    total += len(b["block"]["evidence"]["evidence"])
+                if total:
+                    break
+                await asyncio.sleep(1.0)
+            assert total >= 1, \
+                "maverick equivocation never became committed evidence"
+        finally:
+            runner.cleanup()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=540))

@@ -1,0 +1,145 @@
+"""Light-client verification benchmark (reference:
+light/client_benchmark_test.go): sequential vs bisection verification
+over a synthetic chain, plus the underlying commit-verify cost.
+
+    python tools/light_bench.py [--cpu] [--heights 64] [--vals 32]
+"""
+
+import asyncio
+import hashlib
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_chain(n_heights: int, n_vals: int):
+    from tendermint_tpu.crypto import ed25519
+    from tendermint_tpu.light.types import LightBlock, SignedHeader
+    from tendermint_tpu.types.block import (
+        BlockID, Commit, CommitSig, BlockIDFlag, Header, PartSetHeader,
+    )
+    from tendermint_tpu.types.validator import Validator
+    from tendermint_tpu.types.validator_set import ValidatorSet
+    from tendermint_tpu.types.vote import Vote, VoteType
+
+    chain_id = "light-bench"
+    t0 = 1_700_000_000 * 1_000_000_000
+    privs = [
+        ed25519.Ed25519PrivKey(hashlib.sha256(b"lb%d" % i).digest())
+        for i in range(n_vals)
+    ]
+    vals = ValidatorSet(
+        [Validator.new(p.pub_key(), 10) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    blocks = {}
+    prev_bid = None
+    for h in range(1, n_heights + 1):
+        header = Header(
+            version_block=11, version_app=0, chain_id=chain_id,
+            height=h, time=t0 + h * 10**9, last_block_id=prev_bid,
+            last_commit_hash=b"\x01" * 32, data_hash=b"\x02" * 32,
+            validators_hash=vals.hash(), next_validators_hash=vals.hash(),
+            consensus_hash=b"\x03" * 32, app_hash=b"\x04" * 32,
+            last_results_hash=b"\x05" * 32, evidence_hash=b"\x06" * 32,
+            proposer_address=vals.get_proposer().address,
+        )
+        bid = BlockID(header.hash(), PartSetHeader(1, b"\x07" * 32))
+        sigs = []
+        for idx, val in enumerate(vals.validators):
+            vote = Vote(type=VoteType.PRECOMMIT, height=h, round=0,
+                        block_id=bid, timestamp=header.time + 1,
+                        validator_address=val.address,
+                        validator_index=idx)
+            sig = by_addr[val.address].sign(vote.sign_bytes(chain_id))
+            sigs.append(CommitSig(BlockIDFlag.COMMIT, val.address,
+                                  header.time + 1, sig))
+        commit = Commit(h, 0, bid, sigs)
+        blocks[h] = LightBlock(SignedHeader(header, commit), vals)
+        prev_bid = bid
+    return chain_id, blocks
+
+
+def main():
+    if "--cpu" in sys.argv:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    n_heights, n_vals = 64, 32
+    for i, a in enumerate(sys.argv):
+        if a == "--heights":
+            n_heights = int(sys.argv[i + 1])
+        elif a == "--vals":
+            n_vals = int(sys.argv[i + 1])
+
+    from tendermint_tpu.libs.db import MemDB
+    from tendermint_tpu.light import (
+        Client, LightStore, TrustOptions,
+    )
+    from tendermint_tpu.light.provider import (
+        BlockNotFoundError, Provider,
+    )
+
+    chain_id, blocks = build_chain(n_heights, n_vals)
+    print(f"chain: {n_heights} heights x {n_vals} validators")
+
+    class P(Provider):
+        async def light_block(self, height):
+            if height == 0:
+                height = max(blocks)
+            lb = blocks.get(height)
+            if lb is None:
+                raise BlockNotFoundError(str(height))
+            return lb
+
+    now = blocks[1].time() + (n_heights + 100) * 10**9
+    hour = 3600 * 10**9 * 24 * 365
+
+    async def bisect():
+        cl = Client(chain_id,
+                    TrustOptions(period_ns=hour, height=1,
+                                 hash=blocks[1].hash()),
+                    P(), [], LightStore(MemDB()), now_fn=lambda: now)
+        t = time.perf_counter()
+        await cl.verify_light_block_at_height(n_heights)
+        return time.perf_counter() - t
+
+    async def sequential():
+        cl = Client(chain_id,
+                    TrustOptions(period_ns=hour, height=1,
+                                 hash=blocks[1].hash()),
+                    P(), [], LightStore(MemDB()), now_fn=lambda: now)
+        await cl.initialize()
+        t = time.perf_counter()
+        trusted = cl.store.latest()
+        from tendermint_tpu.light.verifier import verify_adjacent
+
+        for h in range(2, n_heights + 1):
+            verify_adjacent(chain_id, trusted, blocks[h], hour, now)
+            trusted = blocks[h]
+        return time.perf_counter() - t
+
+    async def backwards():
+        cl = Client(chain_id,
+                    TrustOptions(period_ns=hour, height=1,
+                                 hash=blocks[1].hash()),
+                    P(), [], LightStore(MemDB()), now_fn=lambda: now)
+        await cl.verify_light_block_at_height(n_heights)
+        t = time.perf_counter()
+        await cl.verify_light_block_at_height(2)
+        return time.perf_counter() - t
+
+    b = asyncio.run(bisect())
+    s = asyncio.run(sequential())
+    w = asyncio.run(backwards())
+    print(f"bisection to height {n_heights}:  {b * 1e3:8.1f} ms")
+    print(f"sequential (adjacent x{n_heights - 1}): {s * 1e3:8.1f} ms "
+          f"({s / (n_heights - 1) * 1e3:.1f} ms/header)")
+    print(f"backwards walk {n_heights}->2:   {w * 1e3:8.1f} ms")
+
+
+if __name__ == "__main__":
+    main()
