@@ -68,19 +68,36 @@ def pad_messages(msgs: list[bytes], prefix_len: int = 0) -> tuple[np.ndarray, np
     per-message Python beyond the b"".join.
     """
     n = len(msgs)
-    lens = np.fromiter((len(m) for m in msgs), np.int64, count=n)
+    lens = np.fromiter(map(len, msgs), np.int64, count=n)
     total_lens = lens + prefix_len
     # blocks: content + 1 (0x80) + 16 (length) rounded up to 128
     nblocks = (total_lens + 1 + 16 + 127) // 128
     max_blocks = int(nblocks.max()) if n else 1
     width = max_blocks * 128 - prefix_len
     out = np.zeros((n, width), np.uint8)
-    flat = np.frombuffer(b"".join(msgs), np.uint8)
-    if flat.size:
-        rows = np.repeat(np.arange(n), lens)
-        starts = np.concatenate([[0], np.cumsum(lens)[:-1]])
-        cols = np.arange(flat.size) - np.repeat(starts, lens)
-        out[rows, cols] = flat
+    uniq = np.unique(lens) if n else lens
+    if n and uniq.size <= 8:
+        # Fast path: few distinct lengths (a commit's vote sign-bytes
+        # differ only in varint-timestamp width, 2-3 values) — one bulk
+        # reshape+copy per length group instead of the per-byte scatter
+        # (8 ms -> ~1 ms at 10,240 lanes; the scatter was the single
+        # largest host cost in the verify hot path).
+        for length in uniq.tolist():
+            if not length:
+                continue
+            mask = lens == length
+            ii = np.nonzero(mask)[0]
+            block = np.frombuffer(
+                b"".join(msgs[i] for i in ii), np.uint8
+            ).reshape(ii.size, length)
+            out[mask, :length] = block
+    else:
+        flat = np.frombuffer(b"".join(msgs), np.uint8)
+        if flat.size:
+            rows = np.repeat(np.arange(n), lens)
+            starts = np.concatenate([[0], np.cumsum(lens)[:-1]])
+            cols = np.arange(flat.size) - np.repeat(starts, lens)
+            out[rows, cols] = flat
     out[np.arange(n), lens] = 0x80
     # 128-bit big-endian bit length at the end of each lane's final block;
     # bit lengths here always fit 4 bytes (messages < 512 MiB).
