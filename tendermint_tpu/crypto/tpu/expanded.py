@@ -270,11 +270,18 @@ class ExpandedKeys:
         idx = np.asarray(indices, np.int32)
         assert n <= tv._MAX_BATCH, "split huge batches at the call site"
         assert idx.min() >= 0 and idx.max() < len(self.pubkeys)
-        well_formed = np.fromiter(
-            (len(s) == 64 for s in sigs), bool, count=n
-        )
-        if not well_formed.all():
-            sigs = [s if ok else b"\0" * 64 for s, ok in zip(sigs, well_formed)]
+        # Cheap aggregate check first: one join + length compare beats
+        # 10k per-item len() calls in the common all-well-formed case.
+        joined = b"".join(sigs)
+        if len(joined) == 64 * n:
+            well_formed = np.ones(n, bool)
+        else:
+            well_formed = np.fromiter(
+                (len(s) == 64 for s in sigs), bool, count=n
+            )
+            sigs = [s if ok else b"\0" * 64
+                    for s, ok in zip(sigs, well_formed)]
+            joined = b"".join(sigs)
 
         # Bucket: powers of two up to 1024, then multiples of 1024 (a
         # 10,240-lane commit runs at exactly 10,240 instead of padding
@@ -290,10 +297,10 @@ class ExpandedKeys:
         if pad:
             idx = np.concatenate([idx, np.zeros(pad, np.int32)])
             msgs = list(msgs) + [b""] * pad
-            sigs = list(sigs) + [b"\0" * 64] * pad
+            joined += b"\0" * (64 * pad)
 
         a_raw = self._a_raw[idx]
-        sig_raw = np.frombuffer(b"".join(sigs), np.uint8).reshape(bucket, 64)
+        sig_raw = np.frombuffer(joined, np.uint8).reshape(bucket, 64)
         packed = tv.pack_arrays(a_raw, sig_raw, msgs)
         return idx, packed, well_formed
 
