@@ -24,6 +24,10 @@ class BaseConfig:
     node_key_file: str = "config/node_key.json"
     abci: str = "builtin"  # builtin | socket | grpc
     proxy_app: str = "kvstore"
+    # builtin kvstore: take a state-sync snapshot every N heights
+    # (0 = only advertise the live head; reference e2e app
+    # snapshot_interval)
+    snapshot_interval: int = 0
 
     def resolve(self, path: str) -> str:
         return path if os.path.isabs(path) else os.path.join(self.home, path)

@@ -67,6 +67,10 @@ class Manifest:
     timeout_commit_ms: int = 200
     perturbations: list[Perturbation] = field(default_factory=list)
     misbehaviors: list[Misbehavior] = field(default_factory=list)
+    # Hold the LAST node back; once the net has snapshots, start it
+    # with state sync configured from a live trust hash and make it
+    # catch up (reference manifest state_sync node role).
+    late_statesync_node: bool = False
 
     def validate(self) -> None:
         if self.nodes < 1:
@@ -88,7 +92,8 @@ class Manifest:
 
     _KEYS = frozenset({"nodes", "chain_id", "wait_height",
                        "load_tx_rate", "timeout_commit_ms",
-                       "perturbations", "misbehaviors"})
+                       "perturbations", "misbehaviors",
+                       "late_statesync_node"})
     _PERTURB_KEYS = frozenset({"node", "op", "at_height", "duration"})
     _MISBEHAVIOR_KEYS = frozenset({"node", "spec"})
 
@@ -128,6 +133,7 @@ class Manifest:
                 Misbehavior(node=int(mb["node"]), spec=mb["spec"])
                 for mb in d.get("misbehaviors", [])
             ],
+            late_statesync_node=bool(d.get("late_statesync_node", False)),
         )
         m.validate()
         return m

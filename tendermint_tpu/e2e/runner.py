@@ -120,6 +120,12 @@ class Runner:
             cfg.base.home = home
             cfg.base.fast_sync = False
             cfg.consensus.timeout_commit_ms = self.m.timeout_commit_ms
+            if self.m.late_statesync_node:
+                # servers take snapshots; the late joiner fast-syncs
+                # its tail after the snapshot restore
+                cfg.base.snapshot_interval = 4
+                if i == self.m.nodes - 1:
+                    cfg.base.fast_sync = True
             cfg.save(cfg_path)
             mb = ",".join(m.spec for m in self.m.misbehaviors
                           if m.node == i)
@@ -127,10 +133,39 @@ class Runner:
                 i, home, self.base_port + 1000 + i, misbehavior=mb))
 
     def start(self) -> None:
-        for node in self.nodes:
+        held_back = (
+            {self.m.nodes - 1} if self.m.late_statesync_node else set())
+        started = [n for n in self.nodes if n.index not in held_back]
+        for node in started:
             node.start()
-        self.log(f"started {len(self.nodes)} nodes "
-                 f"(pids {[n.pid for n in self.nodes]})")
+        self.log(f"started {len(started)} nodes "
+                 f"(pids {[n.pid for n in started]})")
+
+    async def start_late_statesync_node(self) -> None:
+        """Configure + boot the held-back node once snapshots exist:
+        trust hash from a live RPC commit, rpc_servers pointing at two
+        running nodes (reference node.go:589 wiring via [statesync])."""
+        from ..config import Config
+
+        late = self.nodes[-1]
+        # a snapshot is taken at height 4 (interval 4); the light
+        # provider probes trust..snapshot+2
+        await self.wait_net_height(7)
+        commit = await self._rpc(self.nodes[0], "commit", height=2)
+        trust_hash = commit["signed_header"]["commit"]["block_id"]["hash"]
+        cfg_path = os.path.join(late.home, "config", "config.toml")
+        cfg = Config.load(cfg_path)
+        cfg.statesync.enable = True
+        cfg.statesync.rpc_servers = [
+            f"127.0.0.1:{self.nodes[0].rpc_port}",
+            f"127.0.0.1:{self.nodes[1].rpc_port}",
+        ]
+        cfg.statesync.trust_height = 2
+        cfg.statesync.trust_hash = trust_hash
+        cfg.save(cfg_path)
+        self.log(f"starting late statesync node{late.index} "
+                 f"(trust height 2, hash {trust_hash[:12]}...)")
+        late.start()
 
     # -- RPC helpers --
 
@@ -236,6 +271,8 @@ class Runner:
                             key=lambda p: p.at_height):
                 await self.wait_net_height(p.at_height)
                 await self.apply(p)
+            if self.m.late_statesync_node:
+                await self.start_late_statesync_node()
             await self.wait_all_height(self.m.wait_height)
             self.stop_load()
             report = await self.check()
@@ -254,7 +291,12 @@ class Runner:
         evidence = 0
         for node in self.nodes:
             for height in range(1, h + 1):
-                b = await self._rpc(node, "block", height=height)
+                try:
+                    b = await self._rpc(node, "block", height=height)
+                except Exception:
+                    # a state-synced node legitimately has no blocks
+                    # below its snapshot height
+                    continue
                 hashes.setdefault(height, set()).add(
                     b["block_id"]["hash"])
                 if node.index == 0:

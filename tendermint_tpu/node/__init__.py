@@ -56,7 +56,8 @@ def default_app_creator(config: Config):
             data_dir = config.base.resolve(config.base.db_dir)
             os.makedirs(data_dir, exist_ok=True)
             db = FileDB(os.path.join(data_dir, "app.db"))
-            return ClientCreator(app=PersistentKVStoreApp(db))
+            return ClientCreator(app=PersistentKVStoreApp(
+                db, snapshot_interval=config.base.snapshot_interval))
         if name == "counter":
             from ..abci.counter import CounterApp
 
@@ -168,8 +169,13 @@ class Node(Service):
         self.mempool_reactor = MempoolReactor(
             self.mempool, broadcast=cfg.mempool.broadcast)
         self.ev_reactor = EvidenceReactor(self.evpool)
-        provider = (self.state_provider_factory(self)
-                    if state_sync and self.state_provider_factory else None)
+        if state_sync and self.state_provider_factory is not None:
+            provider = self.state_provider_factory(self)
+        elif state_sync and cfg.statesync.rpc_servers and \
+                cfg.statesync.trust_hash:
+            provider = self._default_state_provider()
+        else:
+            provider = None
         self.ss_reactor = StateSyncReactor(
             self.proxy_app.snapshot, provider,
             discovery_time=cfg.statesync.discovery_time_s)
@@ -280,6 +286,32 @@ class Node(Service):
             self.spawn(self._run_state_sync(), "state-sync")
         elif not self.bc_reactor.fast_sync:
             await self.consensus_state.start()
+
+    def _default_state_provider(self):
+        """Config-driven light-client state provider (reference:
+        statesync/stateprovider.go NewLightClientStateProvider wired
+        from [statesync] rpc_servers + trust height/hash in
+        node.go:589): trusted app hashes come from a light client
+        bisecting over the configured RPC servers."""
+        from ..libs.db import MemDB
+        from ..light import Client, LightStore, TrustOptions
+        from ..light.provider import RPCProvider
+        from ..statesync.stateprovider import LightClientStateProvider
+
+        sc = self.config.statesync
+        providers = []
+        for server in sc.rpc_servers:
+            host, port = _split_laddr(server, default_host="127.0.0.1")
+            providers.append(RPCProvider(host, port))
+        lc = Client(
+            self.genesis_doc.chain_id,
+            TrustOptions(period_ns=sc.trust_period_s * 1_000_000_000,
+                         height=sc.trust_height,
+                         hash=bytes.fromhex(sc.trust_hash)),
+            providers[0], providers[1:], LightStore(MemDB()))
+        return LightClientStateProvider(
+            lc, initial_height=self.genesis_doc.initial_height,
+            consensus_params=self.genesis_doc.consensus_params)
 
     async def _run_state_sync(self) -> None:
         """Snapshot-restore, then fast-sync the tail

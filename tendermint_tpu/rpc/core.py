@@ -687,7 +687,16 @@ def validator_set_from_json(vals_json: list):
         pk = Ed25519PubKey(base64.b64decode(v["pub_key"]["value"]))
         vals.append(Validator(pk.address(), pk, int(v["voting_power"]),
                               int(v.get("proposer_priority", 0))))
-    return ValidatorSet(vals)
+    # Restore EXACTLY (order + proposer priorities): the ValidatorSet
+    # constructor re-runs proposer-priority rotation, which would
+    # desynchronize a state-synced node's proposer schedule from the
+    # chain's (it would then reject every real proposer's signature).
+    # The proposer resolves lazily from the restored priorities.
+    vs = ValidatorSet([])
+    vs.validators = vals
+    if vals:
+        vs.proposer = vs._find_proposer()  # from restored priorities
+    return vs
 
 
 async def serve(env: Environment, host: str, port: int):
