@@ -44,14 +44,9 @@ def worker():
                           "1")
 
     if "--cpu" in sys.argv:
-        # The env var alone does NOT override this machine's axon
-        # sitecustomize; the config update is what actually wins (same
-        # dance as tests/conftest.py). Must run before any device use.
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        import jax
+        from tendermint_tpu.libs.cpuforce import force_cpu_backend
 
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu_backend()
 
     import numpy as np  # noqa: F401  (keeps import cost out of timings)
 

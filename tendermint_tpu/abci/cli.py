@@ -112,6 +112,7 @@ def _new_client(args) -> Client:
 async def _run_lines(args, lines, echo_input: bool) -> int:
     client = _new_client(args)
     await client.start()
+    ok = True
     try:
         first = True
         for line in lines:
@@ -123,8 +124,9 @@ async def _run_lines(args, lines, echo_input: bool) -> int:
                     sys.stdout.write("\n")
                 sys.stdout.write(f"> {line}\n")
             first = False
-            await _exec_line(client, line)
-        return 0
+            ok = await _exec_line(client, line) and ok
+        # nonzero on any unknown command, like the reference abci-cli
+        return 0 if ok else 1
     finally:
         await client.stop()
 

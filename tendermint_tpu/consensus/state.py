@@ -676,15 +676,16 @@ class ConsensusState(Service):
                 missing_power += vals.validators[i].voting_power
         met.missing_validators.set(missing)
         met.missing_validators_power.set(missing_power)
-        # evidence in THIS block tallies byzantine signers
+        # evidence in THIS block tallies byzantine signers (set
+        # unconditionally: the gauges must drop back to 0 on
+        # evidence-free blocks, like the reference's)
         byz = {e.vote_a.validator_address
                for e in block.evidence.evidence
                if hasattr(e, "vote_a")}
-        if byz:
-            met.byzantine_validators.set(len(byz))
-            met.byzantine_validators_power.set(sum(
-                v.voting_power for v in vals.validators
-                if v.address in byz))
+        met.byzantine_validators.set(len(byz))
+        met.byzantine_validators_power.set(sum(
+            v.voting_power for v in vals.validators
+            if v.address in byz))
         if self.priv_validator_address is not None and \
                 vals.has_address(self.priv_validator_address):
             idx, own = vals.get_by_address(self.priv_validator_address)

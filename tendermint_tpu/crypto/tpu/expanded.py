@@ -270,15 +270,16 @@ class ExpandedKeys:
         idx = np.asarray(indices, np.int32)
         assert n <= tv._MAX_BATCH, "split huge batches at the call site"
         assert idx.min() >= 0 and idx.max() < len(self.pubkeys)
-        # Cheap aggregate check first: one join + length compare beats
-        # 10k per-item len() calls in the common all-well-formed case.
-        joined = b"".join(sigs)
-        if len(joined) == 64 * n:
-            well_formed = np.ones(n, bool)
+        # Per-lane length check, vectorized (map(len) runs the loop in
+        # C). An AGGREGATE total-length shortcut would be unsound:
+        # two adjacent malformed sigs of 63+65 bytes cancel out and
+        # every following lane's bytes shift — an accept/reject
+        # divergence between nodes on adversarial commits.
+        lens = np.fromiter(map(len, sigs), np.int64, count=n)
+        well_formed = lens == 64
+        if well_formed.all():
+            joined = b"".join(sigs)
         else:
-            well_formed = np.fromiter(
-                (len(s) == 64 for s in sigs), bool, count=n
-            )
             sigs = [s if ok else b"\0" * 64
                     for s, ok in zip(sigs, well_formed)]
             joined = b"".join(sigs)

@@ -149,10 +149,14 @@ def verify_batch_sr(pubs, msgs, sigs, ctx: bytes = b"") -> np.ndarray:
     s_ints = [int.from_bytes(s_raw[i].tobytes(), "little") for i in range(n)]
     sdig = _nibbles(s_ints, n)
 
-    # Pad to a power-of-two bucket (same policy as the ed25519 path).
-    bucket = tv._MIN_BATCH
-    while bucket < n:
-        bucket <<= 1
+    # Bucket like the ed25519 path: powers of two up to 1024, then
+    # multiples of 1024 (a 10,240-lane batch pads 0% instead of 60%).
+    if n <= 1024:
+        bucket = tv._MIN_BATCH
+        while bucket < n:
+            bucket <<= 1
+    else:
+        bucket = (n + 1023) // 1024 * 1024
     pad = bucket - n
     if pad:
         a_raw = np.pad(a_raw, ((0, pad), (0, 0)))

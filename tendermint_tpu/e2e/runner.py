@@ -40,6 +40,7 @@ class NodeProc:
         self.misbehavior = misbehavior
         self.proc: subprocess.Popen | None = None
         self.log_path = os.path.join(home, "node.log")
+        self._log_f = None
 
     def start(self) -> None:
         assert self.proc is None or self.proc.poll() is not None
@@ -52,9 +53,12 @@ class NodeProc:
                "--home", self.home, "start"]
         if self.misbehavior:
             cmd += ["--misbehavior", self.misbehavior]
+        if self._log_f is not None:
+            self._log_f.close()  # one fd per node, not per restart
+        self._log_f = open(self.log_path, "ab")
         self.proc = subprocess.Popen(
             cmd,
-            stdout=open(self.log_path, "ab"),
+            stdout=self._log_f,
             stderr=subprocess.STDOUT, env=env)
 
     @property
@@ -77,14 +81,16 @@ class NodeProc:
         os.kill(self.pid, signal.SIGCONT)
 
     def terminate(self, timeout: float = 10.0) -> None:
-        if not self.alive():
-            return
-        self.proc.terminate()
-        try:
-            self.proc.wait(timeout)
-        except subprocess.TimeoutExpired:
-            self.proc.kill()
-            self.proc.wait()
+        if self.alive():
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+        if self._log_f is not None:
+            self._log_f.close()
+            self._log_f = None
 
 
 class Runner:

@@ -122,13 +122,19 @@ def verify_light_client_attack(ev, chain_id: str, common_vals,
 
     # Our signed header at the conflicting height — the evidence must
     # actually conflict with the committed chain, and its commit round
-    # feeds the equivocation/amnesia classification below.
+    # feeds the equivocation/amnesia classification below. ONLY the
+    # canonical commit (stored with block c_height+1) may be used: a
+    # locally-seen commit can be at a DIFFERENT round than the
+    # canonical one, which would make the equivocation-vs-amnesia
+    # classification — and thus accept/reject — node-dependent.
+    # Tip evidence simply fails here and is retried by gossip once the
+    # next block lands (reference getSignedHeader does the same).
     trusted_meta = block_store.load_block_meta(c_height)
-    trusted_commit = block_store.load_block_commit(c_height) or \
-        block_store.load_seen_commit(c_height)
+    trusted_commit = block_store.load_block_commit(c_height)
     if trusted_meta is None or trusted_commit is None:
         raise EvidenceError(
-            f"no committed header at conflicting height {c_height}")
+            f"no committed header+commit at conflicting height "
+            f"{c_height} (commit lands with block {c_height + 1})")
     if trusted_meta.header.hash() == sh.header.hash():
         raise EvidenceError("conflicting block matches the committed chain")
     trusted_sh = SignedHeader(trusted_meta.header, trusted_commit)

@@ -1,0 +1,24 @@
+"""Force JAX onto the CPU backend on machines whose sitecustomize
+force-registers an accelerator plugin.
+
+The env var alone is NOT enough here: this machine's axon site hook
+overrides `JAX_PLATFORMS`, and when the TPU relay is wedged even
+`jax.devices()` hangs in backend init. The config update after import
+is what actually wins (same dance as tests/conftest.py). Call BEFORE
+any device use; safe to call twice."""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_backend() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/tmp/tm_tpu_jax_cache")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                          "1")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -48,7 +48,10 @@ class _Ctx:
         self.block_store = BlockStore(MemDB())
         st = self.state
         prev_commit = None
-        for h in (1, 2):
+        # three blocks: the CANONICAL commit for height h is stored
+        # with block h+1, and evidence verification refuses to fall
+        # back to locally-seen commits (round could differ per node)
+        for h in (1, 2, 3):
             block = st.make_block(h, [], prev_commit, [],
                                   vals.get_proposer().address,
                                   GENESIS_TIME + 10 * h)
@@ -82,8 +85,7 @@ def _conflicting_block(ctx, height: int = 2, round_: int = 0, pvs=None,
 
 def _trusted_sh(block_store, height: int) -> SignedHeader:
     meta = block_store.load_block_meta(height)
-    commit = block_store.load_block_commit(height) or \
-        block_store.load_seen_commit(height)
+    commit = block_store.load_block_commit(height)  # canonical only
     return SignedHeader(meta.header, commit)
 
 
@@ -338,8 +340,10 @@ def test_attack_evidence_lands_in_block_on_live_net():
         nodes = await make_net(4)
         try:
             n0 = nodes[0]
+            # height 3 so the CANONICAL commit for height 2 (stored
+            # with block 3) exists on every node
             await asyncio.gather(
-                *(n.cs.wait_for_height(2, timeout=60) for n in nodes))
+                *(n.cs.wait_for_height(3, timeout=60) for n in nodes))
             # Forge a conflicting block 2 signed by the real validators
             # (the attack artifact a light client would extract — a
             # lunatic header anchored at common height 1), and hand the

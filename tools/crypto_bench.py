@@ -25,11 +25,9 @@ def timeit(f, reps):
 
 def main():
     if "--cpu" in sys.argv:
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        import jax
+        from tendermint_tpu.libs.cpuforce import force_cpu_backend
 
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu_backend()
     batch = 1024
     for i, a in enumerate(sys.argv):
         if a == "--batch":

@@ -63,11 +63,9 @@ def build_chain(n_heights: int, n_vals: int):
 
 def main():
     if "--cpu" in sys.argv:
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        import jax
+        from tendermint_tpu.libs.cpuforce import force_cpu_backend
 
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu_backend()
     n_heights, n_vals = 64, 32
     for i, a in enumerate(sys.argv):
         if a == "--heights":

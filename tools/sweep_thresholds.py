@@ -50,11 +50,9 @@ def main():
         elif a == "--sr-sizes":
             SR_SIZES = [int(x) for x in sys.argv[i + 1].split(",")]
     if cpu:
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        import jax
+        from tendermint_tpu.libs.cpuforce import force_cpu_backend
 
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu_backend()
     import jax
 
     device = str(jax.devices()[0])
