@@ -110,12 +110,16 @@ class DoublePropose(Misbehavior):
     name = "double-propose"
 
     async def enter_propose(self, cs, height: int, round_: int) -> bool:
+        # Round 0 only, one-shot: a split proposal usually fails its
+        # round (half the peers hold each block, no polka), and if
+        # EVERY round's rotating proposer re-equivocated the height
+        # would livelock. One equivocation is the attack; all later
+        # rounds/proposers proceed honestly and consensus recovers.
+        if round_ != 0:
+            cs.misbehaviors.pop(height, None)
+            return False
         if not cs._is_proposer() or cs.priv_validator is None:
             return False
-        # One-shot: a proposer that split the net EVERY round of this
-        # height would livelock it (no round ever forms a polka while
-        # half the peers hold each proposal). One equivocation is the
-        # attack; later rounds proceed honestly and consensus recovers.
         cs.misbehaviors.pop(height, None)
         rs = cs.rs
         from ..types.block import Commit, NIL_BLOCK_ID

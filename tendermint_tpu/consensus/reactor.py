@@ -482,23 +482,30 @@ class ConsensusReactor(Reactor):
                 if ps.height != 0 and rs.height > ps.height:
                     if await self._gossip_catchup_part(ps):
                         continue
-                # 3) send the proposal itself (+POL)
-                if rs.height == ps.height and rs.proposal is not None \
+                # 3) send the proposal itself (+POL). SNAPSHOT the
+                # proposal/parts/votes: the `await peer.send` yields to
+                # the event loop, and a round change can null
+                # rs.proposal mid-iteration (observed crashing this
+                # routine under a maverick double-proposal — a dead
+                # gossip routine silently starves the peer).
+                proposal = rs.proposal
+                parts = rs.proposal_block_parts
+                votes = rs.votes
+                if rs.height == ps.height and proposal is not None \
                         and not ps.proposal:
                     await peer.send(DATA_CHANNEL, m.encode_consensus_msg(
-                        m.ProposalMessage(rs.proposal)))
-                    ps.set_proposal(rs.proposal)
-                    if rs.proposal_block_parts is not None:
-                        ps.set_proposal_parts_header(
-                            rs.proposal_block_parts.header())
-                    if rs.proposal.pol_round >= 0 and rs.votes is not None:
-                        pol = rs.votes.prevotes(rs.proposal.pol_round)
+                        m.ProposalMessage(proposal)))
+                    ps.set_proposal(proposal)
+                    if parts is not None:
+                        ps.set_proposal_parts_header(parts.header())
+                    if proposal.pol_round >= 0 and votes is not None:
+                        pol = votes.prevotes(proposal.pol_round)
                         if pol is not None:
                             await peer.send(
                                 DATA_CHANNEL,
                                 m.encode_consensus_msg(m.ProposalPOLMessage(
-                                    height=rs.height,
-                                    proposal_pol_round=rs.proposal.pol_round,
+                                    height=proposal.height,
+                                    proposal_pol_round=proposal.pol_round,
                                     proposal_pol=pol.bit_array())))
                     continue
                 await asyncio.sleep(self.gossip_sleep)

@@ -36,7 +36,7 @@ def run(coro):
 
 
 class _Ctx:
-    """Committed chain: blocks 1 and 2 in the store + valsets saved.
+    """Committed chain: blocks 1-3 in the store + valsets saved.
     Two heights so LUNATIC evidence can anchor at a common height
     strictly BELOW the conflicting height (the reference rejects
     same-height lunatic headers, evidence/verify.go:135-139)."""

@@ -82,11 +82,13 @@ def test_double_propose_net_survives():
         nodes = await make_net(4)
         try:
             # every node schedules it: whoever ends up proposer at h=2
-            # equivocates
+            # equivocates (round 0 only; recovery can take several
+            # rounds when the split lands 2-2, hence the long timeout —
+            # the SAFETY assertion is the no-fork check below)
             for n in nodes:
                 n.cs.misbehaviors[2] = DoublePropose()
             await asyncio.gather(
-                *(n.cs.wait_for_height(4, timeout=120) for n in nodes))
+                *(n.cs.wait_for_height(4, timeout=240) for n in nodes))
             for h in range(1, 4):
                 hashes = {n.block_store.load_block_meta(h).header.hash()
                           for n in nodes}
