@@ -236,7 +236,8 @@ def decode_consensus_msg(data: bytes):
                 prop = Proposal.from_bytes(r.bytes())
             else:
                 r.skip(wt)
-        assert prop is not None
+        if prop is None:
+            raise ValueError("ProposalMessage without a proposal")
         return cls(prop)
     if cls is ProposalPOLMessage:
         height = pol_round = 0
@@ -265,7 +266,8 @@ def decode_consensus_msg(data: bytes):
                 part = _read_part(r.bytes())
             else:
                 r.skip(wt)
-        assert part is not None
+        if part is None:
+            raise ValueError("BlockPartMessage without a part")
         return cls(height, round_, part)
     if cls is VoteMessage:
         vote = None
@@ -275,7 +277,8 @@ def decode_consensus_msg(data: bytes):
                 vote = Vote.from_bytes(r.bytes())
             else:
                 r.skip(wt)
-        assert vote is not None
+        if vote is None:
+            raise ValueError("VoteMessage without a vote")
         return cls(vote)
     if cls is HasVoteMessage:
         kw = dict(height=0, round=0, type=0, index=0)

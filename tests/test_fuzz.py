@@ -342,3 +342,16 @@ def test_evil_mconn_frames():
                 pass
 
     asyncio.run(go())
+
+
+def test_empty_wrapper_messages_reject_cleanly():
+    """A message tag with EMPTY body (e.g. b'\\x06' = VoteMessage with
+    no vote field) must raise ValueError, not AssertionError — found by
+    tools/fuzz_campaign.py; a peer-controlled byte must never trip an
+    assert."""
+    for tag in range(0x10):
+        blob = bytes([tag])
+        try:
+            decode_consensus_msg(blob)
+        except ValueError:
+            pass
