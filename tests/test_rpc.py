@@ -262,3 +262,62 @@ def test_check_tx_and_unsafe_routes(tmp_path):
             await node2.stop()
 
     run(go())
+
+
+def test_rpc_server_survives_malformed_requests(tmp_path):
+    """Garbage HTTP/JSON-RPC bodies must produce error responses (or
+    clean closes), never kill the server (reference jsonrpc server
+    robustness)."""
+    import asyncio as aio
+
+    from test_node import make_home, single_val_genesis
+    from tendermint_tpu.node import Node
+
+    async def go():
+        gdoc, pvs = single_val_genesis()
+        cfg = make_home(tmp_path, "n0", gdoc)
+        pv = pvs[0]
+        pv.key_path = cfg.base.resolve(cfg.base.priv_validator_key_file)
+        pv.state_path = cfg.base.resolve(
+            cfg.base.priv_validator_state_file)
+        pv.save_key()
+        node = Node.default_new_node(cfg)
+        await node.start()
+        try:
+            port = node.rpc_port
+
+            async def raw(payload: bytes) -> bytes:
+                r, w = await aio.open_connection("127.0.0.1", port)
+                w.write(payload)
+                await w.drain()
+                try:
+                    return await aio.wait_for(r.read(4096), 5)
+                finally:
+                    w.close()
+
+            def post(body: bytes) -> bytes:
+                return (b"POST / HTTP/1.1\r\nHost: x\r\n"
+                        b"Content-Length: %d\r\n\r\n%s"
+                        % (len(body), body))
+
+            cases = [
+                b"GET /nonsense HTTP/1.1\r\nHost: x\r\n\r\n",
+                post(b"notjson"),
+                post(b"[]"),
+                post(b'{"method":"status","id":"x"}'),
+                post(b'{"jsonrpc":"2.0","id":1,"method":"block",'
+                     b'"params":"oops"}'),
+                b"\x00\x01\x02 garbage not even http\r\n\r\n",
+            ]
+            for payload in cases:
+                await raw(payload)  # must not hang or kill the server
+            # ...server still answers a well-formed call afterwards
+            from tendermint_tpu.rpc.jsonrpc import HTTPClient
+
+            cli = HTTPClient("127.0.0.1", port, timeout=5)
+            st = await cli.call("status")
+            assert st["node_info"]["network"] == gdoc.chain_id
+        finally:
+            await node.stop()
+
+    run(go())
