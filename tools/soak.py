@@ -1,0 +1,87 @@
+"""Soak test: a live perturbed testnet under tx load for N minutes,
+then a sweep of every node log for silent task deaths.
+
+The gossip-routine crash fixed in round 3 was SILENT — the task died,
+the log line scrolled by, and the net limped. This harness makes that
+class of failure loud: after the run, any Traceback / "died" /
+"Task exception" line in any node log fails the soak.
+
+    python tools/soak.py [--minutes 5] [--nodes 4] [--out DIR]
+"""
+
+import asyncio
+import os
+import re
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SUSPECT = re.compile(
+    rb"Traceback|routine for .* died|Task exception|exception was never"
+    rb"|AssertionError|attribute")
+
+# Benign, expected log noise (peer churn during perturbations).
+ALLOWED = re.compile(
+    rb"stopping peer|unreachable|reconnect|rejected inbound|timed out"
+    rb"|connection lost|flood")
+
+
+def main() -> int:
+    minutes, nodes, out = 5.0, 4, "./soak-net"
+    for i, a in enumerate(sys.argv):
+        if a == "--minutes":
+            minutes = float(sys.argv[i + 1])
+        elif a == "--nodes":
+            nodes = int(sys.argv[i + 1])
+        elif a == "--out":
+            out = sys.argv[i + 1]
+
+    from tendermint_tpu.e2e import Manifest, Runner
+
+    # Perturbation schedule spread over the soak: every node gets hit.
+    height_per_min = 60_000 // 400  # ~150 heights/min at 400ms commits
+    total_h = int(minutes * height_per_min * 0.5)  # conservative bar
+    perturbs = []
+    for k in range(int(minutes)):
+        perturbs.append({
+            "node": k % nodes,
+            "op": ("kill", "pause", "restart", "disconnect")[k % 4],
+            "at_height": 5 + k * max(5, total_h // max(int(minutes), 1)),
+            "duration": 3.0,
+        })
+    m = Manifest.from_dict({
+        "chain_id": "soak-chain",
+        "nodes": nodes,
+        "wait_height": max(20, total_h),
+        "load_tx_rate": 10.0,
+        "timeout_commit_ms": 400,
+        "perturbations": perturbs,
+    })
+    runner = Runner(m, out, base_port=28100)
+    report = asyncio.run(asyncio.wait_for(
+        runner.run(), timeout=minutes * 60 + 600))
+    print("run report:", report)
+
+    bad = []
+    for i in range(nodes):
+        log_path = os.path.join(out, f"node{i}", "node.log")
+        with open(log_path, "rb") as f:
+            for line_no, line in enumerate(f, 1):
+                if SUSPECT.search(line) and not ALLOWED.search(line):
+                    bad.append((i, line_no, line.rstrip()[:160]))
+    if bad:
+        print(f"SOAK FAILED: {len(bad)} suspect log lines:")
+        for node_i, line_no, line in bad[:40]:
+            print(f"  node{node_i}:{line_no}: "
+                  f"{line.decode(errors='replace')}")
+        return 1
+    print(f"soak clean: {nodes} nodes, {minutes} min, "
+          f"{report['txs_sent']} txs, height {report['height']}, "
+          "no silent task deaths")
+    shutil.rmtree(out, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
