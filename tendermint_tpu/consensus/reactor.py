@@ -545,19 +545,26 @@ class ConsensusReactor(Reactor):
                 ps.proposal_block_parts_header != header:
             await asyncio.sleep(self.gossip_sleep)
             return True
+        # Burst several parts per iteration: one part per gossip_sleep
+        # capped catch-up below the net's commit rate on bigger blocks
+        # (same starvation mode as the one-vote-per-tick commit gossip).
         missing = ps.proposal_block_parts.not_()
-        idx, ok = missing.pick_random()
-        if not ok:
+        sent_any = False
+        for _ in range(8):
+            idx, ok = missing.pick_random()
+            if not ok:
+                break
+            part = self.cs.block_store.load_block_part(ps.height, idx)
+            if part is None:
+                break
+            await ps.peer.send(DATA_CHANNEL, m.encode_consensus_msg(
+                m.BlockPartMessage(height=ps.height, round=ps.round,
+                                   part=part)))
+            ps.proposal_block_parts.set(idx, True)
+            missing.set(idx, False)
+            sent_any = True
+        if not sent_any:
             await asyncio.sleep(self.gossip_sleep)
-            return True
-        part = self.cs.block_store.load_block_part(ps.height, idx)
-        if part is None:
-            await asyncio.sleep(self.gossip_sleep)
-            return True
-        await ps.peer.send(DATA_CHANNEL, m.encode_consensus_msg(
-            m.BlockPartMessage(height=ps.height, round=ps.round,
-                               part=part)))
-        ps.proposal_block_parts.set(idx, True)
         return True
 
     async def _gossip_votes_routine(self, ps: PeerState) -> None:
@@ -634,16 +641,23 @@ class ConsensusReactor(Reactor):
             if cs_.for_block():
                 have.set(i, True)
         missing = have.sub(bits)
-        idx, ok = missing.pick_random()
-        if not ok:
-            return False
-        vote = self._commit_to_vote(commit, idx)
-        if vote is None:
-            return False
-        await ps.peer.send(VOTE_CHANNEL, m.encode_consensus_msg(
-            m.VoteMessage(vote)))
-        bits.set(idx, True)
-        return True
+        # Send EVERY missing commit vote in one iteration: a peer this
+        # far behind needs the whole commit to advance, and pacing one
+        # vote per gossip_sleep put the catch-up rate BELOW the net's
+        # commit rate on 6+ validator nets — a restarted node would
+        # chase the tip forever (observed in soak runs).
+        sent = False
+        for idx in range(len(commit.signatures)):
+            if not missing.get(idx):
+                continue
+            vote = self._commit_to_vote(commit, idx)
+            if vote is None:
+                continue
+            await ps.peer.send(VOTE_CHANNEL, m.encode_consensus_msg(
+                m.VoteMessage(vote)))
+            bits.set(idx, True)
+            sent = True
+        return sent
 
     def _commit_to_vote(self, commit, idx: int):
         from ..types.vote import Vote

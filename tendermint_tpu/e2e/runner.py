@@ -124,14 +124,18 @@ class Runner:
 
             cfg = Config.load(cfg_path)
             cfg.base.home = home
-            cfg.base.fast_sync = False
+            # fast_sync ON (reference default): a node restarted after
+            # kill -9 far behind the tip block-syncs the gap — pure
+            # consensus catch-up gossip cannot outrun the net's commit
+            # rate on longer gaps. At genesis everyone is at height 0,
+            # so the pool reports caught-up and switches to consensus
+            # immediately.
+            cfg.base.fast_sync = True
             cfg.consensus.timeout_commit_ms = self.m.timeout_commit_ms
             if self.m.late_statesync_node:
                 # servers take snapshots; the late joiner fast-syncs
                 # its tail after the snapshot restore
                 cfg.base.snapshot_interval = 4
-                if i == self.m.nodes - 1:
-                    cfg.base.fast_sync = True
             cfg.save(cfg_path)
             mb = ",".join(m.spec for m in self.m.misbehaviors
                           if m.node == i)

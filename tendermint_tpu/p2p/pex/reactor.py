@@ -22,7 +22,15 @@ PEX_CHANNEL = 0x00
 _MSG_REQUEST = "pex_request"
 _MSG_ADDRS = "pex_addrs"
 
-_REQUEST_INTERVAL = 60.0     # min seconds between requests from a peer
+_REQUEST_INTERVAL = 60.0     # receiver: min seconds between requests
+# Sender-side spacing must EXCEED the receiver's bar with margin, and
+# must survive reconnects: in a small net the book never fills, the
+# ensure loop re-requests forever, and `_requested` used to reset on
+# every reconnect — two innocent requests < 60s apart made the
+# receiver stop the connection, the churn reset the guard, and the
+# whole net degenerated into mutual flood-flagging (observed starving
+# a kill -9'd node's catch-up for 9+ minutes in a soak run).
+_REQUEST_SEND_SPACING = 90.0
 _ENSURE_PERIOD = 30.0
 
 
@@ -37,6 +45,8 @@ class PEXReactor(Reactor):
         self.ensure_period = ensure_period
         self._last_request_from: dict[str, float] = {}
         self._requested: set[str] = set()
+        # NOT cleared on remove_peer: rate limit outlives reconnects
+        self._last_request_to: dict[str, float] = {}
         self._task = None
 
     def get_channels(self) -> list[ChannelDescriptor]:
@@ -108,6 +118,11 @@ class PEXReactor(Reactor):
         return sw is not None and sw._n_outbound() < sw.max_outbound
 
     async def _request_addrs(self, peer) -> None:
+        now = time.monotonic()
+        if now - self._last_request_to.get(peer.id, -1e9) < \
+                _REQUEST_SEND_SPACING:
+            return  # receiver would (rightly) flag us as flooding
+        self._last_request_to[peer.id] = now
         self._requested.add(peer.id)
         await peer.send(PEX_CHANNEL,
                         json.dumps({"type": _MSG_REQUEST}).encode())

@@ -299,3 +299,54 @@ def test_addrbook_basics(tmp_path):
     book2 = AddrBook(str(tmp_path / "addrbook.json"))
     assert book2.size() == 5
     assert book2._addrs[nk[0].id].bucket_type == "old"
+
+
+def test_pex_request_rate_limit_survives_reconnect():
+    """The sender-side PEX request limiter must persist across
+    reconnects: re-adding the same peer (churn) must NOT produce a
+    second request inside the receiver's flood window (the soak-run
+    failure mode: mutual flood-flagging starving a recovering node)."""
+    import asyncio as aio
+
+    from tendermint_tpu.p2p.pex.addrbook import AddrBook
+    from tendermint_tpu.p2p.pex.reactor import PEXReactor
+
+    class FakePeer:
+        def __init__(self, pid):
+            self.id = pid
+            self.outbound = False
+            self.socket_addr = ""
+            self.sent = []
+
+        async def send(self, chan, msg):
+            self.sent.append(msg)
+
+    class FakeSwitch:
+        max_outbound = 10
+        dialing = set()
+        peers = {}
+
+        def _n_outbound(self):
+            return 0
+
+    async def go():
+        rx = PEXReactor(AddrBook())
+        rx.switch = FakeSwitch()
+        peer = FakePeer("ab" * 20)
+        await rx.add_peer(peer)          # inbound + needs peers -> request
+        assert len(peer.sent) == 1
+        # churn: remove + re-add within the window -> NO second request
+        await rx.remove_peer(peer, "conn lost")
+        await rx.add_peer(peer)
+        assert len(peer.sent) == 1, "re-request inside flood window"
+        # direct re-request attempts are also suppressed
+        await rx._request_addrs(peer)
+        assert len(peer.sent) == 1
+        # after the spacing elapses, requests flow again
+        from tendermint_tpu.p2p.pex import reactor as rmod
+
+        rx._last_request_to[peer.id] -= rmod._REQUEST_SEND_SPACING + 1
+        await rx._request_addrs(peer)
+        assert len(peer.sent) == 2
+
+    aio.run(go())
