@@ -78,6 +78,17 @@ def cmd_init(args) -> int:
 
 def cmd_start(args) -> int:
     """reference: cmd/tendermint/commands/run_node.go:100."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # Honor the operator's platform choice even on machines whose
+        # sitecustomize force-registers an accelerator plugin: without
+        # the config-level override, the first device-path signature
+        # batch tries the accelerator backend, and a wedged relay
+        # freezes the whole node mid-consensus (observed: a restarted
+        # node hanging forever on catch-up vote batches).
+        from ..libs.cpuforce import force_cpu_backend
+
+        force_cpu_backend()
+
     from ..node import Node
 
     logging.basicConfig(

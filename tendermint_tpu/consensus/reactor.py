@@ -262,8 +262,14 @@ class ConsensusReactor(Reactor):
 
     async def switch_to_consensus(self, state, skip_wal: bool = False) -> None:
         """Fast-sync → consensus handoff (reference: SwitchToConsensus,
-        conR.conS.updateToState + start gossip for existing peers)."""
+        reactor.go:106 — reconstructLastCommit THEN updateToState +
+        start gossip for existing peers)."""
         self.cs.update_to_state(state)
+        if state.last_block_height > 0:
+            # Without this a fast-synced node that becomes proposer
+            # cannot build a block ("cannot propose: no last commit")
+            # and a 1/3-power set of such nodes halts the net.
+            self.cs.reconstruct_last_commit()
         self.wait_sync = False
         await self.cs.start()
         for pid, ps in self.peer_states.items():
