@@ -48,7 +48,11 @@ class NodeProc:
         repo_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-        env.setdefault("JAX_PLATFORMS", "cpu")
+        # FORCE cpu (not setdefault): e2e nets are CPU-only by design —
+        # an inherited accelerator platform var pointed soak nodes at
+        # the (wedged) TPU relay, freezing them on their first big
+        # signature batch. The bench owns the real chip.
+        env["JAX_PLATFORMS"] = "cpu"
         cmd = [sys.executable, "-m", "tendermint_tpu.cmd",
                "--home", self.home, "start"]
         if self.misbehavior:
