@@ -554,19 +554,27 @@ class ConsensusReactor(Reactor):
         # Burst several parts per iteration: one part per gossip_sleep
         # capped catch-up below the net's commit rate on bigger blocks
         # (same starvation mode as the one-vote-per-tick commit gossip).
+        # Every send awaits, so the peer can complete its block and
+        # advance (NewRoundStep nulls ps.proposal_block_parts) MID-
+        # burst — the common case when bursting works. Re-check the
+        # live PeerState each iteration and mark via the guarded
+        # set_has_part; a raw .set() here crashed the routine.
+        height, round_ = ps.height, ps.round
         missing = ps.proposal_block_parts.not_()
         sent_any = False
         for _ in range(8):
             idx, ok = missing.pick_random()
             if not ok:
                 break
-            part = self.cs.block_store.load_block_part(ps.height, idx)
+            if ps.height != height or ps.proposal_block_parts is None:
+                break  # peer advanced mid-burst: done with this height
+            part = self.cs.block_store.load_block_part(height, idx)
             if part is None:
                 break
             await ps.peer.send(DATA_CHANNEL, m.encode_consensus_msg(
-                m.BlockPartMessage(height=ps.height, round=ps.round,
+                m.BlockPartMessage(height=height, round=round_,
                                    part=part)))
-            ps.proposal_block_parts.set(idx, True)
+            ps.set_has_part(height, round_, idx)
             missing.set(idx, False)
             sent_any = True
         if not sent_any:

@@ -29,7 +29,11 @@ def main() -> int:
             seconds = float(sys.argv[i + 1])
         elif a == "--seed":
             seed = int(sys.argv[i + 1])
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    from tendermint_tpu.libs.cpuforce import force_cpu_backend
+
+    force_cpu_backend()  # setdefault alone loses to the site hook
 
     import test_fuzz as tf
 

@@ -17,9 +17,12 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-SUSPECT = re.compile(
-    rb"Traceback|routine for .* died|Task exception|exception was never"
-    rb"|AssertionError|attribute")
+# Hard markers are NEVER excused — a dead task line that happens to
+# mention churn words ("timed out", "connection lost") is still a dead
+# task. Weak markers can be excused by the churn whitelist.
+HARD = re.compile(
+    rb"Traceback|routine for .* died|Task exception|exception was never")
+WEAK = re.compile(rb"AssertionError|attribute")
 
 # Benign, expected log noise (peer churn during perturbations).
 ALLOWED = re.compile(
@@ -68,7 +71,8 @@ def main() -> int:
         log_path = os.path.join(out, f"node{i}", "node.log")
         with open(log_path, "rb") as f:
             for line_no, line in enumerate(f, 1):
-                if SUSPECT.search(line) and not ALLOWED.search(line):
+                if HARD.search(line) or (
+                        WEAK.search(line) and not ALLOWED.search(line)):
                     bad.append((i, line_no, line.rstrip()[:160]))
     if bad:
         print(f"SOAK FAILED: {len(bad)} suspect log lines:")
