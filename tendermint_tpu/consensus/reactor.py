@@ -22,7 +22,7 @@ import time
 from ..libs.bits import BitArray
 from ..p2p.conn.connection import ChannelDescriptor
 from ..p2p.switch import Reactor
-from ..types.block import PartSetHeader
+from ..types.block import NIL_BLOCK_ID, PartSetHeader
 from ..types.vote import VoteType
 from . import messages as m
 from .cstypes import RoundState, RoundStep
@@ -186,17 +186,27 @@ class PeerState:
 
     def apply_vote_set_bits(self, msg: m.VoteSetBitsMessage,
                             our_votes: BitArray | None) -> None:
+        """reference: ApplyVoteSetBitsMessage (reactor.go:1362) — the
+        peer's SELF-REPORT replaces our bookkeeping for the reported
+        block's votes (bits outside our tally for that block are kept).
+        Replacement, not OR, is load-bearing: gossip optimistically
+        marks votes as delivered on send, and a vote sent while the
+        peer was still in wait_sync is dropped on its floor — an OR
+        could never clear the stale mark and the peer would be starved
+        of those votes forever (observed deadlocking a restarted node
+        at the prevote step)."""
         bits = self.get_vote_bits(msg.height, msg.round, msg.type)
-        if bits is None:
+        if bits is None or msg.votes.size != bits.size:
             return
         if our_votes is not None and our_votes.size == bits.size:
-            # reference: ours OR (theirs AND NOT ours) == ours OR theirs
-            merged = bits.or_(msg.votes) if msg.votes.size == bits.size \
-                else bits
-            d = self.prevotes if msg.type == VoteType.PREVOTE \
-                else self.precommits
-            if msg.height == self.height and msg.round in d:
-                d[msg.round] = merged
+            other = bits.sub(our_votes)
+            new_bits = other.or_(msg.votes)
+        else:
+            new_bits = msg.votes  # conservative overwrite
+        d = self.prevotes if msg.type == VoteType.PREVOTE \
+            else self.precommits
+        if msg.height == self.height and msg.round in d:
+            d[msg.round] = new_bits
         elif msg.votes.size == bits.size:
             d = self.prevotes if msg.type == VoteType.PREVOTE \
                 else self.precommits
@@ -290,10 +300,18 @@ class ConsensusReactor(Reactor):
         # other reactors (evidence, mempool) read the peer's consensus
         # height from here (reference: types.PeerStateKey on peer kv)
         peer.set("consensus_peer_state", ps)
-        # tell the new peer where we are (reference sendNewRoundStepMessage)
-        peer.try_send(STATE_CHANNEL, m.encode_consensus_msg(
-            _new_round_step_msg(self.cs.rs)))
+        # Tell the new peer where we are (reference AddPeer: it sends
+        # NewRoundStep ONLY when !WaitSync, reactor.go:199). While
+        # fast/state sync runs, this reactor DROPS incoming consensus
+        # messages — advertising a (height, round) here would invite
+        # peers to firehose votes into that drop window and mark them
+        # delivered, permanently starving us of them after the switch
+        # (observed deadlocking a restarted node, and with it the net).
+        # Peers learn our real position from the step broadcasts that
+        # fire when consensus starts.
         if not self.wait_sync:
+            peer.try_send(STATE_CHANNEL, m.encode_consensus_msg(
+                _new_round_step_msg(self.cs.rs)))
             self._start_gossip(ps)
 
     def _start_gossip(self, ps: PeerState) -> None:
@@ -379,6 +397,9 @@ class ConsensusReactor(Reactor):
                         ours = vs.bit_array_by_block_id(None) \
                             if msg.block_id is None or msg.block_id.is_nil() \
                             else vs.bit_array_by_block_id(msg.block_id)
+                logger.debug("bits from %s h=%d r=%d t=%d: %s (ours %s)",
+                             peer.id[:8], msg.height, msg.round,
+                             msg.type, msg.votes, ours)
                 ps.apply_vote_set_bits(msg, ours)
             else:
                 raise ValueError(
@@ -401,6 +422,9 @@ class ConsensusReactor(Reactor):
         our_bits = vs.bit_array_by_block_id(msg.block_id) if vs else None
         if our_bits is None:
             our_bits = BitArray(len(rs.validators) if rs.validators else 0)
+        logger.debug("maj23 from %s h=%d r=%d t=%d; replying bits %s",
+                     peer.id[:8], msg.height, msg.round, msg.type,
+                     our_bits)
         await peer.send(VOTE_SET_BITS_CHANNEL, m.encode_consensus_msg(
             m.VoteSetBitsMessage(height=msg.height, round=msg.round,
                                  type=msg.type, block_id=msg.block_id,
@@ -635,8 +659,22 @@ class ConsensusReactor(Reactor):
                 return True
         return False
 
+    def _load_commit(self, height: int):
+        """Commit for `height` FOR GOSSIP: the canonical one when block
+        height+1 exists, else the locally-seen commit at the tip
+        (reference consensus/state.go LoadCommit). Without the tip
+        fallback, a peer finishing the tip height can never be fed its
+        missing precommits — observed deadlocking a restarted node (and
+        with it the whole net, once >1/3 power depended on it).
+        Evidence verification deliberately does NOT use this (rounds of
+        seen commits differ per node; gossip only needs valid votes)."""
+        bs = self.cs.block_store
+        if height == bs.height:
+            return bs.load_seen_commit(height)
+        return bs.load_block_commit(height)
+
     async def _gossip_catchup_commit(self, ps: PeerState) -> bool:
-        commit = self.cs.block_store.load_block_commit(ps.height)
+        commit = self._load_commit(ps.height)
         if commit is None:
             return False
         # Rebuild votes from commit sigs; need that height's valset —
@@ -703,6 +741,9 @@ class ConsensusReactor(Reactor):
         ok = await ps.peer.send(VOTE_CHANNEL,
                                 m.encode_consensus_msg(m.VoteMessage(vote)))
         if ok:
+            logger.debug("sent vote h=%d r=%d t=%d idx=%d to %s",
+                         vote.height, vote.round, int(vote.type), idx,
+                         ps.peer.id[:8])
             ps.set_has_vote(vote.height, vote.round, int(vote.type), idx)
         return ok
 
@@ -724,16 +765,28 @@ class ConsensusReactor(Reactor):
                         if vs is None:
                             continue
                         bid, ok = vs.two_thirds_majority()
-                        if ok and bid is not None:
+                        if ok:
+                            # NIL majorities announce too (bid None =
+                            # +2/3 for nil): the bits-reconciliation
+                            # reply is what un-starves a peer whose
+                            # votes were sent into its wait_sync window
+                            # — skipping nil deadlocked a restarted
+                            # node at the prevote step (no proposer ->
+                            # the majority IS nil in that scenario).
+                            logger.debug(
+                                "announce maj23 h=%d r=%d t=%d to %s",
+                                rs.height, ps.round, int(type_),
+                                ps.peer.id[:8])
                             await ps.peer.send(
                                 STATE_CHANNEL,
                                 m.encode_consensus_msg(m.VoteSetMaj23Message(
                                     height=rs.height, round=ps.round,
-                                    type=int(type_), block_id=bid)))
+                                    type=int(type_),
+                                    block_id=bid or NIL_BLOCK_ID)))
                 # catchup: advertise the commit of the peer's height
                 if rs.height != ps.height and ps.height > 0 and \
                         ps.height >= self.cs.block_store.base:
-                    commit = self.cs.block_store.load_block_commit(ps.height)
+                    commit = self._load_commit(ps.height)
                     if commit is not None:
                         await ps.peer.send(
                             STATE_CHANNEL,

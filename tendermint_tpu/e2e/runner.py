@@ -55,6 +55,8 @@ class NodeProc:
         env["JAX_PLATFORMS"] = "cpu"
         cmd = [sys.executable, "-m", "tendermint_tpu.cmd",
                "--home", self.home, "start"]
+        if os.environ.get("TM_E2E_DEBUG"):
+            cmd += ["--log_level", "debug"]
         if self.misbehavior:
             cmd += ["--misbehavior", self.misbehavior]
         if self._log_f is not None:

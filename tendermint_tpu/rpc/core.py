@@ -343,6 +343,22 @@ class Environment:
 
     async def dump_consensus_state(self, ctx) -> dict:
         base = await self.consensus_state(ctx)
+        rs = self.node.consensus_state.rs
+        # Per-round vote tallies (reference dump includes the
+        # HeightVoteSet's bit-array renderings).
+        votes = []
+        if rs.votes is not None:
+            for rnd in sorted(rs.votes._round_vote_sets):
+                pv = rs.votes.prevotes(rnd)
+                pc = rs.votes.precommits(rnd)
+                votes.append({
+                    "round": rnd,
+                    "prevotes": str(pv.bit_array()) if pv else "",
+                    "prevotes_power": str(pv.sum if pv else 0),
+                    "precommits": str(pc.bit_array()) if pc else "",
+                    "precommits_power": str(pc.sum if pc else 0),
+                })
+        base["round_state"]["height_vote_set"] = votes
         reactor = self.node.consensus_reactor
         base["peers"] = [{
             "node_address": pid,
