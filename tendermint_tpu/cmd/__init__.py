@@ -108,9 +108,20 @@ def cmd_start(args) -> int:
 
     async def run():
         node = Node.default_new_node(cfg)
-        # Maverick mode (reference: test/maverick — a node binary with
-        # pluggable misbehaviors): --misbehavior double-prevote@H
+        # Maverick mode (reference: test/maverick — a SEPARATE node
+        # binary with pluggable misbehaviors): --misbehavior
+        # double-prevote@H. Equivocation bypasses the PrivValidator
+        # double-sign guard and gets a production validator slashed,
+        # so the flag is inert unless TM_TPU_ENABLE_MAVERICK=1 marks
+        # the process as a test node.
         if args.misbehavior:
+            if os.environ.get("TM_TPU_ENABLE_MAVERICK") != "1":
+                raise SystemExit(
+                    "--misbehavior deliberately equivocates (slashable);"
+                    " refusing without TM_TPU_ENABLE_MAVERICK=1")
+            logging.getLogger("node").warning(
+                "MAVERICK MODE: this node will misbehave: %s",
+                args.misbehavior)
             from ..consensus.misbehavior import MISBEHAVIORS
 
             for spec in args.misbehavior.split(","):

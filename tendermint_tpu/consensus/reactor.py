@@ -207,11 +207,6 @@ class PeerState:
             else self.precommits
         if msg.height == self.height and msg.round in d:
             d[msg.round] = new_bits
-        elif msg.votes.size == bits.size:
-            d = self.prevotes if msg.type == VoteType.PREVOTE \
-                else self.precommits
-            if msg.height == self.height and msg.round in d:
-                d[msg.round] = msg.votes
 
     def ensure_catchup_commit(self, height: int, round_: int,
                               num_validators: int) -> None:

@@ -59,6 +59,7 @@ class NodeProc:
             cmd += ["--log_level", "debug"]
         if self.misbehavior:
             cmd += ["--misbehavior", self.misbehavior]
+            env["TM_TPU_ENABLE_MAVERICK"] = "1"  # e2e test net only
         if self._log_f is not None:
             self._log_f.close()  # one fd per node, not per restart
         self._log_f = open(self.log_path, "ab")

@@ -135,8 +135,15 @@ class Client:
                 raise LightClientError(
                     f"backwards verification failed at height "
                     f"{cur.height() - 1}: {e}") from e
-            self.store.save(interim)
+            # Interim blocks are NOT persisted (reference client.go:
+            # "Intermediate headers are not saved to database"): the
+            # hash-chain walk proves linkage only — the interim
+            # commits' signatures were never verified, and a stored
+            # block would later read as fully trusted (served to
+            # peers, used as a divergence anchor). Only the requested
+            # target is saved, below.
             cur = interim
+        self.store.save(cur)
         return cur
 
     async def update(self, now_ns: int | None = None) -> LightBlock | None:

@@ -133,11 +133,15 @@ class Node(Service):
 
         self.evpool = EvidencePool(_db(cfg, "evidence", self.in_memory),
                                    self.state_store, self.block_store)
-        from ..state.txindex import IndexerService, TxIndexer
+        from ..state.txindex import (BlockIndexer, IndexerService,
+                                     TxIndexer)
 
         self.tx_indexer = TxIndexer(_db(cfg, "txindex", self.in_memory))
-        self.indexer_service = IndexerService(self.tx_indexer,
-                                              self.event_bus)
+        self.block_indexer = BlockIndexer(
+            _db(cfg, "blockindex", self.in_memory))
+        self.indexer_service = IndexerService(
+            self.tx_indexer, self.event_bus,
+            block_indexer=self.block_indexer)
         self.mempool = CListMempool(cfg.mempool, self.proxy_app.mempool,
                                     height=self.state.last_block_height)
         self.block_exec = BlockExecutor(

@@ -124,6 +124,8 @@ class Environment:
             "num_unconfirmed_txs": self.num_unconfirmed_txs,
             "tx": self.tx,
             "tx_search": self.tx_search,
+            "block_search": self.block_search,
+            "genesis_chunked": self.genesis_chunked,
             "broadcast_evidence": self.broadcast_evidence,
             "check_tx": self.check_tx,
             # unsafe routes (reference routes.go AddUnsafeRoutes;
@@ -554,6 +556,50 @@ class Environment:
             {"hash": _hex(t.hash()), "height": str(t.height),
              "index": t.index, "tx_result": t.result, "tx": _b64(t.tx)}
             for t in sel]}
+
+    async def block_search(self, ctx, query="", page=1, per_page=30,
+                           order_by="asc") -> dict:
+        """Search blocks by BeginBlock/EndBlock events (released
+        v0.34.x BlockSearch; the pinned reference predates the route —
+        query language and paging match tx_search)."""
+        bi = getattr(self.node, "block_indexer", None)
+        if bi is None:
+            raise RPCError(-32603, "block indexing disabled")
+        heights = bi.search(Query.parse(query))
+        if order_by == "desc":
+            heights = list(reversed(heights))
+        page, per_page = max(int(page), 1), min(max(int(per_page), 1), 100)
+        start = (page - 1) * per_page
+        blocks = []
+        for h in heights[start:start + per_page]:
+            meta = self.node.block_store.load_block_meta(h)
+            block = self.node.block_store.load_block(h)
+            if meta is None or block is None:
+                continue
+            blocks.append({"block_id": _block_id_json(meta.block_id),
+                           "block": _block_json(block)})
+        return {"total_count": str(len(heights)), "blocks": blocks}
+
+    _GENESIS_CHUNK = 16 * 1024 * 1024
+
+    async def genesis_chunked(self, ctx, chunk=0) -> dict:
+        """Paged genesis download for documents too big for one
+        response (released v0.34.x GenesisChunked; 16 MiB chunks).
+        Chunks are computed once — the genesis doc is immutable, and a
+        big doc is the only reason this route gets called."""
+        chunks = getattr(self, "_genesis_chunks", None)
+        if chunks is None:
+            data = self.node.genesis_doc.to_json().encode()
+            chunks = self._genesis_chunks = [
+                data[i:i + self._GENESIS_CHUNK]
+                for i in range(0, len(data), self._GENESIS_CHUNK)] or [b""]
+        i = int(chunk)
+        if not 0 <= i < len(chunks):
+            raise RPCError(
+                -32603, f"there are {len(chunks)} chunks, "
+                f"{i} is invalid (should be between 0 and {len(chunks)-1})")
+        return {"chunk": str(i), "total": str(len(chunks)),
+                "data": _b64(chunks[i])}
 
     async def broadcast_evidence(self, ctx, evidence="") -> dict:
         from ..types.evidence import evidence_from_bytes

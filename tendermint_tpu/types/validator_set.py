@@ -34,6 +34,8 @@ class VerificationError(Exception):
 class ValidatorSet:
     def __init__(self, validators: list[Validator]):
         self._total: int | None = None
+        self._addr_cache: dict = {}
+        self._addr_cache_src: list | None = None
         if validators:
             vals = [v.copy() for v in validators]
             vals.sort(key=lambda v: (-v.voting_power, v.address))
@@ -56,11 +58,25 @@ class ValidatorSet:
                 raise ValueError("total voting power exceeds cap")
         return self._total
 
+    def _addr_index(self) -> dict:
+        """address -> index map, rebuilt when the validators list is
+        replaced or grows (callers outside this class assign/append to
+        .validators directly, so validity is keyed on the list object
+        + its length rather than on construction sites). Turns the
+        per-conflicting-vote / per-evidence-item lookups — and
+        update_with_change_set's has_address loop — from O(n) scans
+        into O(1) at the 10k-validator design point (the reference
+        keeps sorted order + binary search, validator_set.go:646)."""
+        vals = self.validators
+        if self._addr_cache_src is not vals or \
+                len(self._addr_cache) != len(vals):
+            self._addr_cache = {v.address: i for i, v in enumerate(vals)}
+            self._addr_cache_src = vals
+        return self._addr_cache
+
     def get_by_address(self, addr: bytes) -> tuple[int, Validator | None]:
-        for i, v in enumerate(self.validators):
-            if v.address == addr:
-                return i, v
-        return -1, None
+        i = self._addr_index().get(addr, -1)
+        return (i, self.validators[i]) if i >= 0 else (-1, None)
 
     def get_by_index(self, i: int) -> Validator | None:
         if 0 <= i < len(self.validators):

@@ -235,9 +235,12 @@ def test_backwards_verification():
     lb3 = run(cl.verify_light_block_at_height(3))
     assert lb3.height() == 3
     assert lb3.hash() == chain.blocks[3].hash()
-    # interim headers were persisted on the way down
-    for h in range(3, 8):
-        assert cl.store.get(h) is not None
+    # interim headers are NOT persisted (reference client.go:
+    # "Intermediate headers are not saved to database") — their commit
+    # signatures were never verified; only the requested target is.
+    for h in range(4, 8):
+        assert cl.store.get(h) is None
+    assert cl.store.get(3) is not None
 
     # unit: a forged interim header breaks the hash link
     good = chain.blocks[5].signed_header.header

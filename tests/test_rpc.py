@@ -126,6 +126,30 @@ def test_rpc_surface(tmp_path):
 
             nut = await cli.call("num_unconfirmed_txs")
             assert nut["n_txs"] == "0"
+
+            # block_search: every committed block is indexed; height
+            # equality and range queries both resolve
+            bs = await cli.call("block_search",
+                                query=f"block.height = {tx_height}")
+            assert bs["total_count"] == "1"
+            assert bs["blocks"][0]["block"]["header"]["height"] == \
+                str(tx_height)
+            bs2 = await cli.call("block_search",
+                                 query="block.height >= 1",
+                                 per_page=2, order_by="desc")
+            assert int(bs2["total_count"]) >= 2
+            assert len(bs2["blocks"]) == 2
+            h0 = int(bs2["blocks"][0]["block"]["header"]["height"])
+            h1 = int(bs2["blocks"][1]["block"]["header"]["height"])
+            assert h0 > h1
+
+            # genesis_chunked: one chunk for a small doc, reassembles
+            gc = await cli.call("genesis_chunked", chunk=0)
+            assert gc["total"] == "1"
+            chunk = json.loads(base64.b64decode(gc["data"]))
+            assert chunk["chain_id"] == "rpc-chain"
+            with pytest.raises(RPCError):
+                await cli.call("genesis_chunked", chunk=5)
         finally:
             await node.stop()
 

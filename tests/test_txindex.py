@@ -1,0 +1,86 @@
+"""Unit tests for the tx and block event indexers
+(reference: state/txindex/kv/kv_test.go; BlockIndexer matches the
+released v0.34.x state/indexer/block/kv semantics)."""
+
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.libs.pubsub import Query
+from tendermint_tpu.state.txindex import BlockIndexer, TxIndexer, TxResult
+
+
+def _tx(height, index, tx, events):
+    return TxResult(height, index, tx, {"code": 0, "events": events})
+
+
+def _ev(etype, **attrs):
+    return {"type": etype,
+            "attributes": [{"key": k, "value": v}
+                           for k, v in attrs.items()]}
+
+
+def test_tx_search_equality_and_ranges():
+    ix = TxIndexer(MemDB())
+    ix.index(_tx(1, 0, b"a", [_ev("transfer", amount="100")]))
+    ix.index(_tx(2, 0, b"b", [_ev("transfer", amount="250")]))
+    ix.index(_tx(2, 1, b"c", [_ev("mint", amount="100")]))
+
+    got = ix.search(Query.parse("transfer.amount = '100'"))
+    assert [t.tx for t in got] == [b"a"]
+    # unquoted numeric literal must match the string-stored attribute
+    got = ix.search(Query.parse("transfer.amount = 100"))
+    assert [t.tx for t in got] == [b"a"]
+    got = ix.search(Query.parse("tx.height = 2"))
+    assert [t.tx for t in got] == [b"b", b"c"]
+    got = ix.search(Query.parse("tx.height > 1"))
+    assert [t.tx for t in got] == [b"b", b"c"]
+
+
+def test_tx_search_slash_value_not_prefix_matched():
+    ix = TxIndexer(MemDB())
+    ix.index(_tx(1, 0, b"plain", [_ev("app", path="5")]))
+    ix.index(_tx(2, 0, b"slashy", [_ev("app", path="5/x")]))
+    got = ix.search(Query.parse("app.path = '5'"))
+    assert [t.tx for t in got] == [b"plain"]
+    got = ix.search(Query.parse("app.path = '5/x'"))
+    assert [t.tx for t in got] == [b"slashy"]
+
+
+def test_block_indexer_search():
+    bi = BlockIndexer(MemDB())
+    bi.index(1, {"events": [_ev("rewards", amount="10")]}, {})
+    bi.index(2, {}, {"events": [_ev("rewards", amount="100")]})
+    bi.index(3, {"events": [_ev("slash", val="v1")]}, {})
+
+    assert bi.search(Query.parse("block.height = 2")) == [2]
+    assert bi.search(Query.parse("block.height >= 2")) == [2, 3]
+    # unquoted number matches the string-stored value, not "100.0"
+    assert bi.search(Query.parse("rewards.amount = 100")) == [2]
+    assert bi.search(Query.parse("slash.val = 'v1'")) == [3]
+    assert bi.search(Query.parse("rewards.amount > 50")) == [2]
+    assert bi.search(Query.parse("rewards.amount <= 50")) == [1]
+
+
+def test_block_indexer_exists_and_slash_values():
+    bi = BlockIndexer(MemDB())
+    bi.index(1, {"events": [_ev("app", denom="atom")]}, {})
+    bi.index(2, {"events": [_ev("app", denom="atom/chan-0")]}, {})
+
+    # EXISTS on a never-emitted event matches nothing (not everything)
+    assert bi.search(Query.parse("ghost.key EXISTS")) == []
+    assert bi.search(Query.parse("app.denom EXISTS")) == [1, 2]
+    # a value extending the queried one past '/' is not a match
+    assert bi.search(Query.parse("app.denom = 'atom'")) == [1]
+    assert bi.search(Query.parse("app.denom = 'atom/chan-0'")) == [2]
+
+
+def test_height_literal_edge_cases():
+    bi = BlockIndexer(MemDB())
+    bi.index(3, {"events": [_ev("e", k="v")]}, {})
+    # fractional height matches nothing (no truncation to 3)
+    assert bi.search(Query.parse("block.height = 3.5")) == []
+    # non-numeric height matches nothing instead of raising
+    assert bi.search(Query.parse("block.height = 'abc'")) == []
+    ix = TxIndexer(MemDB())
+    ix.index(_tx(3, 0, b"t", []))
+    assert ix.search(Query.parse("tx.height = 3.5")) == []
+    assert ix.search(Query.parse("tx.height = 'abc'")) == []
+    assert [t.tx for t in ix.search(Query.parse("tx.height = 3"))] == [b"t"]
