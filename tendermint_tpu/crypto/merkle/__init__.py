@@ -175,3 +175,42 @@ class ProofOperators(list):
             except Exception:
                 return False
         return bool(args) and args[0] == root and not keys
+
+
+class ProofRuntime:
+    """Registry of ProofOp decoders (reference:
+    crypto/merkle/proof_op.go ProofRuntime): apps emit wire-level
+    `ProofOp(type, key, data)` triples; verifiers decode each through
+    the decoder registered for its type and run the resulting
+    operator chain. Keypaths here are `list[bytes]` (innermost key
+    LAST, matching ProofOperators.verify) rather than the reference's
+    URL-escaped KeyPath strings."""
+
+    def __init__(self):
+        self._decoders: dict[str, object] = {}
+
+    def register(self, op_type: str, decoder) -> None:
+        self._decoders[op_type] = decoder
+
+    def decode(self, op: ProofOp) -> ProofOperator:
+        dec = self._decoders.get(op.op_type)
+        if dec is None:
+            raise ValueError(f"unregistered proof op type {op.op_type!r}")
+        return dec(op)
+
+    def _operators(self, ops: list[ProofOp]) -> ProofOperators:
+        return ProofOperators(self.decode(op) for op in ops)
+
+    def verify_value(self, ops: list[ProofOp], root: bytes,
+                     keypath: list[bytes], value: bytes) -> bool:
+        try:
+            return self._operators(ops).verify_value(root, keypath, value)
+        except ValueError:
+            return False
+
+    def verify_absence(self, ops: list[ProofOp], root: bytes,
+                       keypath: list[bytes]) -> bool:
+        try:
+            return self._operators(ops).verify(root, keypath, [])
+        except ValueError:
+            return False

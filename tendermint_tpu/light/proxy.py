@@ -33,9 +33,14 @@ class LightProxy:
     BlockStoreProvider primary).
     """
 
-    def __init__(self, client: Client, forward_client=None):
+    def __init__(self, client: Client, forward_client=None,
+                 proof_runtime=None):
         self.client = client
         self.forward = forward_client
+        # app-defined proof formats decode through this registry
+        # (reference: lrpc.KeyPathFn/prt options); default knows the
+        # kvstore ops, apps with their own formats inject a runtime
+        self._prt = proof_runtime
         self.server = JSONRPCServer(self._routes())
         self.port: int | None = None
 
@@ -58,8 +63,9 @@ class LightProxy:
             "health": self.health,
         }
         if self.forward is not None:
+            routes["abci_query"] = self.abci_query
             for name in ("broadcast_tx_sync", "broadcast_tx_async",
-                         "broadcast_tx_commit", "abci_query", "abci_info",
+                         "broadcast_tx_commit", "abci_info",
                          "tx", "tx_search", "net_info",
                          "broadcast_evidence"):
                 routes[name] = self._forwarder(name)
@@ -153,6 +159,100 @@ class LightProxy:
                 f"verified header at height {lb.height()} is "
                 f"{want.hex()[:16]}… — refusing to relay a forged block")
         return res
+
+    async def abci_query(self, ctx, path="", data="", height=0,
+                         prove=True) -> dict:
+        """Query the primary and PROVE the answer against the
+        light-verified app hash (reference light/rpc/client.go:104-151
+        ABCIQueryWithOptions): prove is forced on, the response must
+        carry proof ops, and the value (or its absence) is verified
+        via the ProofRuntime against header(resp.height+1).app_hash —
+        the app hash for height H lives in header H+1. A tampered
+        value, forged proof, or proof against the wrong state fails
+        here instead of reaching the caller."""
+        import base64
+
+        res = await self._forwarder("abci_query")(
+            ctx, path=path, data=data, height=height, prove=True)
+        resp = res.get("response", {})
+        if int(resp.get("code", 0)) != 0:
+            raise RPCError(-32603,
+                           f"err response code: {resp.get('code')}")
+        key = base64.b64decode(resp.get("key") or "")
+        if not key:
+            raise RPCError(-32603, "empty key in query response")
+        # The proof must be about the key WE asked for — a primary
+        # that answers with a different key (and a perfectly valid
+        # proof for it) must not pass.
+        from ..rpc.core import coerce_hex_param
+
+        data = coerce_hex_param(data)
+        want = bytes.fromhex(data) if data else b""
+        if key != want:
+            raise RPCError(
+                -32603,
+                f"primary answered for key {key.hex()[:16]}… but "
+                f"{want.hex()[:16]}… was queried")
+        ops_json = (resp.get("proof_ops") or {}).get("ops") or []
+        if not ops_json:
+            raise RPCError(
+                -32603, "no proof ops in query response (the app must "
+                "support Prove=true for verified queries)")
+        h = int(resp.get("height") or 0)
+        if h <= 0:
+            raise RPCError(-32603, "zero or negative query height")
+        # The app hash for state h is committed in header h+1, which
+        # may be one block-time away when the query hits the app's
+        # live head — absorb only THAT race (block-not-found) with a
+        # bounded wait; verification failures are deterministic and
+        # surface immediately.
+        import asyncio
+
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while True:
+            try:
+                lb = await self.client.verify_light_block_at_height(h + 1)
+                break
+            except BlockNotFoundError as e:
+                if asyncio.get_running_loop().time() >= deadline:
+                    raise RPCError(
+                        -32603, f"header {h + 1} (carrying the app "
+                        f"hash for query height {h}) not available: {e}")
+                await asyncio.sleep(0.2)
+            except LightClientError as e:
+                raise RPCError(-32603, f"light verification failed: {e}")
+        app_hash = lb.signed_header.header.app_hash
+        from ..crypto.merkle import ProofOp
+
+        ops = [ProofOp(o["type"], base64.b64decode(o.get("key") or ""),
+                       base64.b64decode(o.get("data") or ""))
+               for o in ops_json]
+        value = base64.b64decode(resp.get("value") or "")
+        rt = self._proof_runtime()
+        if value:
+            ok = rt.verify_value(ops, app_hash, [key], value)
+        else:
+            # An empty value is EITHER a proven absence OR a key
+            # legitimately stored with an empty value — b64 JSON
+            # cannot carry the reference's nil-vs-empty distinction,
+            # so accept whichever proof the app sent; both pin the
+            # relayed (empty) answer to the trusted root.
+            ok = rt.verify_absence(ops, app_hash, [key]) or \
+                rt.verify_value(ops, app_hash, [key], b"")
+        if not ok:
+            raise RPCError(
+                -32603,
+                f"proof verification failed for key {key.hex()[:16]}… "
+                f"against app_hash of verified header {h + 1} — "
+                "refusing to relay an unproven query result")
+        return res
+
+    def _proof_runtime(self):
+        if getattr(self, "_prt", None) is None:
+            from ..abci.kv_proofs import kv_proof_runtime
+
+            self._prt = kv_proof_runtime()
+        return self._prt
 
     # -- pass-through routes --
 

@@ -50,13 +50,18 @@ def default_app_creator(config: Config):
     """reference: proxy.DefaultClientCreator — builtin kvstore or a
     socket to an external app."""
     name = config.base.proxy_app
-    if config.base.abci == "builtin" or name in ("kvstore", "counter",
-                                                 "noop"):
-        if name == "kvstore":
+    if config.base.abci == "builtin" or name in ("kvstore",
+                                                 "merkle-kvstore",
+                                                 "counter", "noop"):
+        if name in ("kvstore", "merkle-kvstore"):
+            from ..abci.kvstore import MerkleKVStoreApp
+
             data_dir = config.base.resolve(config.base.db_dir)
             os.makedirs(data_dir, exist_ok=True)
             db = FileDB(os.path.join(data_dir, "app.db"))
-            return ClientCreator(app=PersistentKVStoreApp(
+            cls = MerkleKVStoreApp if name == "merkle-kvstore" \
+                else PersistentKVStoreApp
+            return ClientCreator(app=cls(
                 db, snapshot_interval=config.base.snapshot_interval))
         if name == "counter":
             from ..abci.counter import CounterApp
@@ -160,15 +165,20 @@ class Node(Service):
         if self.priv_validator is not None:
             self.consensus_state.set_priv_validator(self.priv_validator)
 
-        state_sync = cfg.statesync.enable and \
+        # A net whose ONLY validator is us has nobody to sync from:
+        # both sync modes would wait for peers forever, so they are
+        # disabled (reference node.go:677,702 onlyValidatorIsUs).
+        solo = self._only_validator_is_us()
+        state_sync = cfg.statesync.enable and not solo and \
             self.state.last_block_height == 0
-        wait_sync = cfg.base.fast_sync or state_sync
+        fast_sync = cfg.base.fast_sync and not solo
+        wait_sync = fast_sync or state_sync
         self.consensus_reactor = ConsensusReactor(
             self.consensus_state, wait_sync=wait_sync,
             gossip_sleep=cfg.consensus.peer_gossip_sleep_ms / 1000.0)
         self.bc_reactor = BlockchainReactor(
             self.state, self.block_exec, self.block_store,
-            fast_sync=cfg.base.fast_sync and not state_sync,
+            fast_sync=fast_sync and not state_sync,
             consensus_reactor=self.consensus_reactor)
         self.mempool_reactor = MempoolReactor(
             self.mempool, broadcast=cfg.mempool.broadcast)
@@ -233,6 +243,16 @@ class Node(Service):
         self._built = True
 
     # -- lifecycle (reference OnStart node.go:852) --
+
+    def _only_validator_is_us(self) -> bool:
+        """reference node.go:312 onlyValidatorIsUs."""
+        if self.priv_validator is None:
+            return False
+        vals = self.state.validators
+        if vals is None or len(vals) != 1:
+            return False
+        return vals.validators[0].address == \
+            self.priv_validator.get_pub_key().address()
 
     async def on_start(self) -> None:
         if not self._built:

@@ -21,6 +21,18 @@ from .jsonrpc import RPCError
 _SUBSCRIBER_PREFIX = "ws-"
 
 
+def coerce_hex_param(data) -> str:
+    """All-digit hex strings arrive int-coerced from URI params;
+    re-render losslessly (hex data always has even length, so a
+    leading zero is the only ambiguity — restore it by parity).
+    Shared by the node's abci_query and the light proxy's key check."""
+    if isinstance(data, int):
+        data = str(data)
+        if len(data) % 2:
+            data = "0" + data
+    return data
+
+
 def _b64(b: bytes) -> str:
     return base64.b64encode(b).decode()
 
@@ -382,21 +394,20 @@ class Environment:
 
     async def abci_query(self, ctx, path="", data="", height=0,
                          prove=False) -> dict:
-        # All-digit hex strings arrive int-coerced from URI params;
-        # re-render losslessly (hex data always has even length, so a
-        # leading zero is the only ambiguity — restore it by parity).
-        if isinstance(data, int):
-            data = str(data)
-            if len(data) % 2:
-                data = "0" + data
+        data = coerce_hex_param(data)
         res = await self.node.proxy_app.query.query(abci.RequestQuery(
             data=bytes.fromhex(data) if data else b"",
             path=path, height=int(height), prove=bool(prove)))
-        return {"response": {
+        out = {
             "code": res.code, "log": res.log, "index": str(res.index),
             "key": _b64(res.key or b""), "value": _b64(res.value or b""),
             "height": str(res.height),
-        }}
+        }
+        if res.proof_ops:
+            out["proof_ops"] = {"ops": [
+                {"type": op["type"], "key": _b64(op["key"]),
+                 "data": _b64(op["data"])} for op in res.proof_ops]}
+        return {"response": out}
 
     # -- txs --
 

@@ -42,3 +42,111 @@ def test_proof_wrong_index_fails():
     p = proofs[3]
     p.index = 4
     assert not p.verify(root, items[3])
+
+
+# --- kvstore proof ops (abci/kv_proofs.py + MerkleKVStoreApp) ---------------
+
+
+def _merkle_app(pairs):
+    from tendermint_tpu.abci import types as t
+    from tendermint_tpu.abci.kvstore import MerkleKVStoreApp
+    from tendermint_tpu.libs.db import MemDB
+
+    app = MerkleKVStoreApp(MemDB())
+    for k, v in pairs:
+        app.deliver_tx(t.RequestDeliverTx(tx=k + b"=" + v))
+    app.commit(t.RequestCommit())
+    return app
+
+
+def _query(app, key, prove=True):
+    from tendermint_tpu.abci import types as t
+
+    return app.query(t.RequestQuery(data=key, prove=prove))
+
+
+def _ops(resp):
+    from tendermint_tpu.crypto.merkle import ProofOp
+
+    return [ProofOp(o["type"], o["key"], o["data"])
+            for o in resp.proof_ops]
+
+
+def test_kv_value_proof_roundtrip_and_tamper():
+    from tendermint_tpu.abci.kv_proofs import kv_proof_runtime
+
+    app = _merkle_app([(b"a", b"1"), (b"m", b"2"), (b"z", b"3")])
+    rt = kv_proof_runtime()
+    resp = _query(app, b"m")
+    assert resp.value == b"2" and resp.proof_ops
+    ops = _ops(resp)
+    assert rt.verify_value(ops, app.app_hash, [b"m"], b"2")
+    # tampered value, wrong key, wrong root all fail
+    assert not rt.verify_value(ops, app.app_hash, [b"m"], b"20")
+    assert not rt.verify_value(ops, app.app_hash, [b"q"], b"2")
+    assert not rt.verify_value(ops, b"\xee" * 32, [b"m"], b"2")
+    # value proof cannot double as an absence proof
+    assert not rt.verify_absence(ops, app.app_hash, [b"m"])
+
+
+def test_kv_absence_proofs():
+    from tendermint_tpu.abci.kv_proofs import kv_proof_runtime
+
+    app = _merkle_app([(b"b", b"1"), (b"d", b"2"), (b"f", b"3")])
+    rt = kv_proof_runtime()
+    for missing in (b"a", b"c", b"e", b"g"):  # before/between/after
+        resp = _query(app, missing)
+        assert resp.value == b"" and resp.proof_ops, missing
+        ops = _ops(resp)
+        assert rt.verify_absence(ops, app.app_hash, [missing]), missing
+        # an absence proof for one key does not transfer to another
+        assert not rt.verify_absence(ops, app.app_hash, [b"d"])
+        # and never "proves" a present key absent
+        assert not rt.verify_absence(
+            _ops(_query(app, b"d")), app.app_hash, [b"d"])
+
+
+def test_kv_absence_empty_store():
+    from tendermint_tpu.abci import types as t
+    from tendermint_tpu.abci.kv_proofs import kv_proof_runtime
+    from tendermint_tpu.abci.kvstore import MerkleKVStoreApp
+    from tendermint_tpu.libs.db import MemDB
+
+    app = MerkleKVStoreApp(MemDB())
+    app.commit(t.RequestCommit())
+    rt = kv_proof_runtime()
+    resp = _query(app, b"anything")
+    assert rt.verify_absence(_ops(resp), app.app_hash, [b"anything"])
+
+
+def test_kv_forged_neighbor_rejected():
+    import json as _json
+
+    from tendermint_tpu.abci.kv_proofs import kv_proof_runtime
+    from tendermint_tpu.crypto.merkle import ProofOp
+
+    app = _merkle_app([(b"b", b"1"), (b"d", b"2"), (b"f", b"3")])
+    rt = kv_proof_runtime()
+    ops = _ops(_query(app, b"c"))
+    # rewrite the left neighbor's key so it no longer straddles b"c"
+    d = _json.loads(ops[0].data)
+    d["left"]["key"] = b"e".hex()
+    forged = [ProofOp(ops[0].op_type, ops[0].key,
+                      _json.dumps(d).encode())]
+    assert not rt.verify_absence(forged, app.app_hash, [b"c"])
+    # non-adjacent neighbors (drop left, keep a right at index 2) fail
+    d2 = _json.loads(ops[0].data)
+    d2["left"] = None
+    forged2 = [ProofOp(ops[0].op_type, ops[0].key,
+                       _json.dumps(d2).encode())]
+    assert not rt.verify_absence(forged2, app.app_hash, [b"c"])
+
+
+def test_merkle_app_hash_changes_with_state():
+    app = _merkle_app([(b"a", b"1")])
+    h1 = app.app_hash
+    from tendermint_tpu.abci import types as t
+
+    app.deliver_tx(t.RequestDeliverTx(tx=b"a=2"))
+    app.commit(t.RequestCommit())
+    assert app.app_hash != h1

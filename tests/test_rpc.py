@@ -21,7 +21,7 @@ def run(coro):
     return asyncio.run(coro)
 
 
-async def start_node(tmp_path):
+async def start_node(tmp_path, proxy_app="kvstore"):
     import os
 
     home = str(tmp_path / "rpcnode")
@@ -33,6 +33,7 @@ async def start_node(tmp_path):
     cfg = Config()
     cfg.base.home = home
     cfg.base.moniker = "rpc-node"
+    cfg.base.proxy_app = proxy_app
     cfg.base.fast_sync = False
     cfg.consensus = fast_consensus_config()
     cfg.consensus.wal_file = "data/cs.wal/wal"
