@@ -840,19 +840,61 @@ class ConsensusState(Service):
                 # vote, transient executor failure) must not kill this
                 # task: the node would keep enqueueing votes that no
                 # one ever verifies — consensus halting while gossip
-                # and RPC still look healthy. Fall back to the sync
-                # path vote by vote; irrecoverable votes are dropped
-                # with a log line, recoverable ones still tally.
+                # and RPC still look healthy. Degrade to per-vote HOST
+                # verification — but still OFF the event loop and
+                # outside _state_mtx (a device failure during a
+                # 10k-sig burst must not turn into seconds of on-loop
+                # crypto that blocks gossip, timeouts and RPC); the
+                # mutex is then held only per-vote for the tally.
                 self.logger.exception(
-                    "vote batch of %d failed; retrying via sync path",
-                    len(batch))
-                for vote, peer_id, _ in batch:
+                    "vote batch of %d failed; degrading to host-verify "
+                    "off-loop", len(batch))
+                chain_id = self.state.chain_id
+
+                def _host_verify_all(b=batch, cid=chain_id):
+                    out = []
+                    for vote, _pid, pk in b:
+                        try:
+                            out.append(pk.verify_signature(
+                                vote.sign_bytes(cid), vote.signature))
+                        except Exception:
+                            out.append(False)
+                    return out
+
+                try:
+                    verdicts = await loop.run_in_executor(
+                        None, _host_verify_all)
+                except Exception:
+                    self.logger.exception(
+                        "degraded host verify failed; dropping batch")
+                    continue
+                per_peer: dict[str, list[int]] = {}
+                for (vote, peer_id, _), ok in zip(batch, verdicts):
+                    if peer_id:
+                        counts = per_peer.setdefault(peer_id, [0, 0])
+                        counts[0 if ok else 1] += 1
+                    if not ok:
+                        self.logger.debug(
+                            "degraded path rejected vote from %r",
+                            peer_id)
+                        continue
                     try:
                         async with self._state_mtx:
-                            await self._try_add_vote(vote, peer_id)
+                            await self._try_add_vote(vote, peer_id,
+                                                     preverified=True)
                     except Exception:
                         self.logger.exception(
                             "dropping unprocessable vote from %r", peer_id)
+                # Same trust feedback as the happy path: a peer
+                # streaming invalid votes must not farm free host
+                # crypto just because the device is down.
+                rep = self.reporter_fn()
+                if rep is not None:
+                    for peer_id, (good, bad) in per_peer.items():
+                        rep.observe(peer_id, good=good, bad=bad)
+                        if bad:
+                            await rep.enforce(peer_id,
+                                              "invalid vote signature")
 
     async def _verify_and_commit_batch(self, batch, met, loop) -> None:
         met.vote_batch_size.observe(len(batch))

@@ -160,20 +160,78 @@ class WALCorruptionError(Exception):
 
 
 class WAL:
-    """File-backed WAL. write() buffers; write_sync() flushes + fsyncs.
-    The consensus loop write_sync's before acting on any message that
-    could change state (matching BaseWAL.WriteSync, wal.go:201)."""
+    """File-backed WAL with size-bounded rotation. write() buffers;
+    write_sync() flushes + fsyncs. The consensus loop write_sync's
+    before acting on any message that could change state (matching
+    BaseWAL.WriteSync, wal.go:201).
 
-    def __init__(self, path: str):
+    Rotation mirrors autofile.Group (reference consensus/wal.go:97 on
+    libs/autofile/group.go:301): the head file lives at `path`; when
+    it crosses head_size_limit it is renamed to `path.NNN` (NNN
+    ascending, oldest = smallest) and a fresh head opens. When the
+    segments together exceed total_size_limit the oldest are deleted
+    (group.go:268 checkTotalSizeLimit) — replay data for long-
+    committed heights is owned by the block/state stores, not the
+    WAL. Rotation happens between records, so every segment is a
+    clean record sequence; only the head can have a torn tail."""
+
+    HEAD_SIZE_LIMIT = 10 * 1024 * 1024  # group.go:21
+    TOTAL_SIZE_LIMIT = 1 << 30          # group.go:22
+
+    def __init__(self, path: str, head_size_limit: int | None = None,
+                 total_size_limit: int | None = None):
         self.path = path
+        self.head_size_limit = head_size_limit or self.HEAD_SIZE_LIMIT
+        self.total_size_limit = total_size_limit or self.TOTAL_SIZE_LIMIT
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._f = open(path, "ab")
+        self._head_size = os.path.getsize(path)
+
+    # -- segments --
+
+    def _rotated_indices(self) -> list[int]:
+        d = os.path.dirname(self.path) or "."
+        base = os.path.basename(self.path) + "."
+        out = []
+        for name in os.listdir(d):
+            if name.startswith(base) and name[len(base):].isdigit():
+                out.append(int(name[len(base):]))
+        return sorted(out)
+
+    def segment_paths(self) -> list[str]:
+        """All segment files, oldest first, head last."""
+        return [f"{self.path}.{i:03d}" for i in self._rotated_indices()] \
+            + [self.path]
+
+    def _rotate(self) -> None:
+        self.flush_and_sync()
+        self._f.close()
+        idxs = self._rotated_indices()
+        nxt = (idxs[-1] + 1) if idxs else 0
+        os.rename(self.path, f"{self.path}.{nxt:03d}")
+        self._f = open(self.path, "ab")
+        self._head_size = 0
+        # total-size bound: drop oldest segments
+        segs = self.segment_paths()
+        sizes = {p: os.path.getsize(p) for p in segs if os.path.exists(p)}
+        total = sum(sizes.values())
+        for p in segs[:-1]:
+            if total <= self.total_size_limit:
+                break
+            total -= sizes.get(p, 0)
+            os.unlink(p)
+
+    # -- writing --
 
     def write(self, msg: object, time_ns: int = 0) -> None:
         data = _encode_wal_msg(TimedWALMessage(time_ns, msg))
         if len(data) > MAX_MSG_SIZE:
             raise ValueError(f"WAL message too big: {len(data)}")
-        self._f.write(_FRAME.pack(zlib.crc32(data), len(data)) + data)
+        frame = _FRAME.pack(zlib.crc32(data), len(data)) + data
+        self._f.write(frame)
+        self._head_size += len(frame)
+        if self._head_size >= self.head_size_limit:
+            self._rotate()
 
     def write_sync(self, msg: object, time_ns: int = 0) -> None:
         self.write(msg, time_ns)
@@ -193,12 +251,15 @@ class WAL:
     # -- reading --
 
     @staticmethod
-    def decode_all(path: str, strict: bool = False) -> list[TimedWALMessage]:
-        """Read every record; on a corrupt/torn record, stop (strict=False
-        — crash tails are expected) or raise (strict=True)."""
+    def _decode_file(path: str,
+                     strict: bool = False
+                     ) -> tuple[list[TimedWALMessage], int, int]:
+        """Read every record; returns (messages, consumed_bytes,
+        file_size). On a corrupt/torn record, stop (strict=False —
+        crash tails are expected) or raise (strict=True)."""
         out: list[TimedWALMessage] = []
         if not os.path.exists(path):
-            return out
+            return out, 0, 0
         with open(path, "rb") as f:
             data = f.read()
         pos = 0
@@ -220,25 +281,64 @@ class WAL:
                     raise
                 break
             pos += _FRAME.size + ln
+        return out, pos, len(data)
+
+    @staticmethod
+    def decode_all(path: str, strict: bool = False) -> list[TimedWALMessage]:
+        return WAL._decode_file(path, strict)[0]
+
+    def _read_segment(self, path: str) -> list[TimedWALMessage]:
+        """One segment's valid records. Rotated segments were sealed
+        at a record boundary, so mid-file corruption is real — the
+        valid prefix is still returned (dropping it could erase the
+        very EndHeightMessage recovery is looking for), with a
+        warning for the lost tail. The head's torn tail is expected
+        (crash) and not warned about here; repair() handles it."""
+        import logging
+
+        msgs, consumed, size = self._decode_file(path)
+        if consumed < size and path != self.path:
+            logging.getLogger("wal").warning(
+                "corrupt rotated WAL segment %s: %d of %d bytes "
+                "unreadable after record %d",
+                path, size - consumed, size, len(msgs))
+        return msgs
+
+    def read_all(self) -> list[TimedWALMessage]:
+        """Every valid record across all segments, oldest first."""
+        out: list[TimedWALMessage] = []
+        for p in self.segment_paths():
+            out.extend(self._read_segment(p))
         return out
 
     def search_for_end_height(self, height: int) -> tuple[list[TimedWALMessage], bool]:
         """Messages AFTER the EndHeightMessage for `height` (i.e. the
         in-flight messages of height+1), and whether it was found
-        (reference wal.go:231 SearchForEndHeight)."""
-        msgs = self.decode_all(self.path)
-        idx = None
-        for i, m in enumerate(msgs):
-            if isinstance(m.msg, EndHeightMessage) and m.msg.height == height:
-                idx = i
-        if idx is None:
-            return [], False
-        return msgs[idx + 1 :], True
+        (reference wal.go:231 SearchForEndHeight) — spanning segment
+        boundaries: the marker may sit in a rotated segment while the
+        in-flight tail continues in the head. Segments are scanned
+        NEWEST first and the scan stops at the first (newest) segment
+        containing the marker, so boot cost is ~one segment, not the
+        whole group (the group can be 1 GiB)."""
+        segs = self.segment_paths()
+        newer_tail: list[TimedWALMessage] = []
+        for p in reversed(segs):
+            msgs = self._read_segment(p)
+            idx = None
+            for i, m in enumerate(msgs):
+                if isinstance(m.msg, EndHeightMessage) and \
+                        m.msg.height == height:
+                    idx = i
+            if idx is not None:
+                return msgs[idx + 1:] + newer_tail, True
+            newer_tail = msgs + newer_tail
+        return [], False
 
     def repair(self) -> bool:
-        """Truncate a corrupted tail in place, keeping every valid
-        record (reference: consensus/state.go:2217 repairWalFile).
-        Returns True if anything was cut."""
+        """Truncate a corrupted tail of the HEAD segment in place,
+        keeping every valid record (reference: consensus/state.go:2217
+        repairWalFile — crashes only ever tear the file being
+        appended). Returns True if anything was cut."""
         good = self.decode_all(self.path)
         valid_bytes = 0
         for m in good:
@@ -251,4 +351,5 @@ class WAL:
         with open(self.path, "r+b") as f:
             f.truncate(valid_bytes)
         self._f = open(self.path, "ab")
+        self._head_size = valid_bytes
         return True

@@ -17,12 +17,38 @@ not worth it under ``_DEVICE_THRESHOLD`` signatures.
 
 from __future__ import annotations
 
+import logging
+import time
+
 import numpy as np
 
 from . import PubKey
 
+logger = logging.getLogger("crypto.batch")
+
 # Below this many sigs, host verification beats the device round trip.
 _DEVICE_THRESHOLD = 16
+
+# Device-failure degradation: a kernel launch raising (wedged relay,
+# OOM, backend death) marks the device down for a cooldown; every
+# caller transparently gets host verdicts — identical semantics, just
+# slower — instead of an exception on a consensus-critical path. The
+# device is retried after the cooldown so a recovered backend is
+# picked back up without a restart.
+DEVICE_RETRY_COOLDOWN_S = 30.0
+_device_down_until = 0.0
+
+
+def device_available() -> bool:
+    return time.monotonic() >= _device_down_until
+
+
+def mark_device_failed() -> None:
+    global _device_down_until
+    _device_down_until = time.monotonic() + DEVICE_RETRY_COOLDOWN_S
+    from ..libs.metrics import crypto_metrics
+
+    crypto_metrics().device_failures.inc()
 
 
 class BatchVerifier:
@@ -75,16 +101,24 @@ class BatchVerifier:
             use_dev = self._use_device
             if use_dev is None:
                 use_dev = len(items) >= _DEVICE_THRESHOLD
-            if use_dev:
-                from .tpu import verify as tpu_verify
+            if use_dev and device_available():
+                try:
+                    from .tpu import verify as tpu_verify
 
-                met.batch_lanes.inc(len(items), backend="tpu")
-                met.device_launches.inc()
-                return tpu_verify.verify_batch(
-                    [pk.bytes() for pk, _, _ in items],
-                    [m for _, m, _ in items],
-                    [s for _, _, s in items],
-                )
+                    met.device_launches.inc()
+                    out = tpu_verify.verify_batch(
+                        [pk.bytes() for pk, _, _ in items],
+                        [m for _, m, _ in items],
+                        [s for _, _, s in items],
+                    )
+                    met.batch_lanes.inc(len(items), backend="tpu")
+                    return out
+                except Exception:
+                    mark_device_failed()
+                    logger.exception(
+                        "device ed25519 batch failed (%d lanes); "
+                        "degrading to host for %.0fs",
+                        len(items), DEVICE_RETRY_COOLDOWN_S)
             met.batch_lanes.inc(len(items), backend="host")
             # Host path: the per-key OpenSSL fast path (strict-accept ->
             # accept; reject -> ZIP-215 oracle recheck, crypto/ed25519.py).
@@ -100,16 +134,25 @@ class BatchVerifier:
             use_dev = self._use_device
             if use_dev is None:
                 use_dev = len(items) >= _DEVICE_THRESHOLD
-            if use_dev:
-                from .tpu import sr_verify
+            if use_dev and device_available():
+                try:
+                    from .tpu import sr_verify
 
-                met.batch_lanes.inc(len(items), backend="tpu-sr25519")
-                met.device_launches.inc()
-                return sr_verify.verify_batch_sr(
-                    [pk.bytes() for pk, _, _ in items],
-                    [m for _, m, _ in items],
-                    [s for _, _, s in items],
-                )
+                    met.device_launches.inc()
+                    out = sr_verify.verify_batch_sr(
+                        [pk.bytes() for pk, _, _ in items],
+                        [m for _, m, _ in items],
+                        [s for _, _, s in items],
+                    )
+                    met.batch_lanes.inc(len(items),
+                                        backend="tpu-sr25519")
+                    return out
+                except Exception:
+                    mark_device_failed()
+                    logger.exception(
+                        "device sr25519 batch failed (%d lanes); "
+                        "degrading to host for %.0fs",
+                        len(items), DEVICE_RETRY_COOLDOWN_S)
         met.batch_lanes.inc(len(items), backend=f"host-{type_name}")
         # Remaining key types (secp256k1; small sr25519 groups):
         # host-side one-by-one via the PubKey objects we already hold.

@@ -257,6 +257,10 @@ class CryptoMetrics:
     invalid_sigs: Counter = field(default_factory=lambda: DEFAULT.counter(
         "invalid_signatures_total", "Lanes that failed verification.",
         "crypto"))
+    device_failures: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "device_failures_total",
+        "Device batch launches that raised; host degradation engaged.",
+        "crypto"))
 
 
 @dataclass

@@ -225,15 +225,26 @@ class ValidatorSet:
         per-validator comb tables (cached on device across heights —
         see crypto/tpu/expanded.py); everything else through the
         general BatchVerifier."""
-        if len(lanes) >= _EXPAND_MIN and all(
-                self.validators[i].pub_key.type_name == "ed25519"
-                for i in lanes):
+        from ..crypto import batch as _batch
+
+        if len(lanes) >= _EXPAND_MIN and _batch.device_available() and \
+                all(self.validators[i].pub_key.type_name == "ed25519"
+                    for i in lanes):
             from ..crypto.tpu import expanded
 
-            exp = expanded.get_expanded(
-                [v.pub_key.bytes() for v in self.validators])
-            verdicts = exp.verify(lanes, msgs, sigs)
-            return bool(verdicts.all()), verdicts
+            try:
+                exp = expanded.get_expanded(
+                    [v.pub_key.bytes() for v in self.validators])
+                verdicts = exp.verify(lanes, msgs, sigs)
+                return bool(verdicts.all()), verdicts
+            except Exception:
+                # dead device mid-table-build or mid-launch: degrade
+                # to the BatchVerifier (which itself degrades device
+                # -> host) instead of failing the commit verify
+                _batch.mark_device_failed()
+                _batch.logger.exception(
+                    "expanded-valset verify failed (%d lanes); "
+                    "degrading", len(lanes))
         bv = BatchVerifier()
         for i, m, s in zip(lanes, msgs, sigs):
             bv.add(self.validators[i].pub_key, m, s)

@@ -194,3 +194,91 @@ def test_batch_verdicts_feed_trust_metric():
             await node.stop()
 
     run(go())
+
+
+def test_net_stays_live_under_persistent_device_failure():
+    """VERDICT r3 weak #6 done-bar: with the device kernels
+    PERMANENTLY raising (dead relay/backend) and the device threshold
+    forced to 1 so every batch tries the device, a 4-validator net
+    keeps producing blocks: BatchVerifier degrades device -> host
+    inside verify(), every call site (vote scheduler, commit verify,
+    expanded valset) inherits it, and the degraded crypto runs off
+    the event loop."""
+    async def go():
+        from tendermint_tpu.crypto import batch as B
+        from tendermint_tpu.crypto.tpu import verify as tv
+
+        from test_consensus import wire_network
+
+        gdoc, pvs = make_genesis(4)
+        nodes = [Node(gdoc, pv) for pv in pvs]
+        for n in nodes:
+            await n.start()
+
+        def boom(*a, **k):
+            raise RuntimeError("synthetic persistent device failure")
+
+        orig_vb, orig_thr = tv.verify_batch, B._DEVICE_THRESHOLD
+        tv.verify_batch = boom
+        B._DEVICE_THRESHOLD = 1
+        B._device_down_until = 0.0
+        # make the cooldown expire constantly so the dead device is
+        # RETRIED during the run (worst case), not just skipped
+        orig_cd = B.DEVICE_RETRY_COOLDOWN_S
+        B.DEVICE_RETRY_COOLDOWN_S = 0.05
+        try:
+            wire_network(nodes)
+            await asyncio.gather(*[
+                n.cs.wait_for_height(3, timeout=60) for n in nodes
+            ])
+        finally:
+            tv.verify_batch = orig_vb
+            B._DEVICE_THRESHOLD = orig_thr
+            B.DEVICE_RETRY_COOLDOWN_S = orig_cd
+            B._device_down_until = 0.0
+            for n in nodes:
+                await n.stop()
+
+    run(go())
+
+
+def test_device_failure_cooldown_and_recovery():
+    """A raising device marks itself down for a cooldown (host
+    verdicts, correct), is not retried while down, and is picked back
+    up after the cooldown without a restart."""
+    from tendermint_tpu.crypto import batch as B
+    from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+    from tendermint_tpu.crypto.tpu import verify as tv
+
+    calls = []
+
+    def boom(*a, **k):
+        calls.append(1)
+        raise RuntimeError("dead device")
+
+    orig = tv.verify_batch
+    tv.verify_batch = boom
+    B._device_down_until = 0.0
+    try:
+        sk = Ed25519PrivKey.generate()
+        msg, sig = b"m", None
+        sig = sk.sign(msg)
+        bv = B.BatchVerifier(use_device=True)
+        bv.add(sk.pub_key(), msg, sig)
+        ok, v = bv.verify()
+        assert ok and list(v) == [True]  # host fallback, same verdict
+        assert len(calls) == 1 and not B.device_available()
+        # down: device not retried
+        bv2 = B.BatchVerifier(use_device=True)
+        bv2.add(sk.pub_key(), msg, sig)
+        assert bv2.verify()[0]
+        assert len(calls) == 1
+        # cooldown expired: device retried
+        B._device_down_until = 0.0
+        bv3 = B.BatchVerifier(use_device=True)
+        bv3.add(sk.pub_key(), msg, sig)
+        assert bv3.verify()[0]
+        assert len(calls) == 2
+    finally:
+        tv.verify_batch = orig
+        B._device_down_until = 0.0

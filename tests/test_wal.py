@@ -72,3 +72,140 @@ def test_wal_repair_truncates_tail(tmp_path):
     wal2.close()
     msgs = WAL.decode_all(path)
     assert [type(m.msg) for m in msgs] == [EndHeightMessage, EndHeightMessage]
+
+
+def test_wal_rotation_basic(tmp_path):
+    """Head rotates at the size bound; records stay readable in order
+    across segments (reference: autofile/group.go:301 rotation)."""
+    import os
+
+    path = str(tmp_path / "wal")
+    wal = WAL(path, head_size_limit=256)
+    for h in range(1, 21):
+        wal.write(MsgInfo("", b"msg-%02d" % h))
+        wal.write_sync(EndHeightMessage(h))
+    wal.close()
+    segs = wal.segment_paths()
+    assert len(segs) > 2, "expected multiple rotated segments"
+    assert all(os.path.exists(p) for p in segs)
+    msgs = WAL(path, head_size_limit=256).read_all()
+    heights = [m.msg.height for m in msgs
+               if isinstance(m.msg, EndHeightMessage)]
+    assert heights == list(range(1, 21))
+
+
+def test_wal_search_spans_rotation_boundary(tmp_path):
+    """The end-height marker can land in a rotated segment while the
+    next height's in-flight tail continues in the head."""
+    path = str(tmp_path / "wal")
+    wal = WAL(path, head_size_limit=128)
+    for h in range(1, 11):
+        wal.write(MsgInfo("", b"work-for-height-%d" % h))
+        wal.write_sync(EndHeightMessage(h))
+    wal.write(MsgInfo("", b"inflight-h11-a"))
+    wal.write(MsgInfo("", b"inflight-h11-b"))
+    wal.close()
+    tail, found = WAL(path, head_size_limit=128).search_for_end_height(10)
+    assert found
+    assert [m.msg.msg_bytes for m in tail] == \
+        [b"inflight-h11-a", b"inflight-h11-b"]
+    # a height whose marker was never written is still not-found
+    _, found99 = WAL(path, head_size_limit=128).search_for_end_height(99)
+    assert not found99
+
+
+def test_wal_crash_recovery_across_rotation(tmp_path):
+    """VERDICT r4 done-bar: torn tail in the HEAD after several
+    rotations — repair truncates only the head, rotated segments stay
+    intact, and writing continues."""
+    import os
+
+    path = str(tmp_path / "wal")
+    wal = WAL(path, head_size_limit=128)
+    for h in range(1, 9):
+        wal.write(MsgInfo("", b"payload-%d" % h))
+        wal.write_sync(EndHeightMessage(h))
+    wal.write_sync(MsgInfo("", b"good-tail"))
+    wal.close()
+    # simulate a crash mid-append on the head
+    with open(path, "ab") as f:
+        f.write(b"\xde\xad\xbe\xef-torn-record")
+    pre_segments = [p for p in WAL(path).segment_paths()[:-1]]
+    pre_sizes = {p: os.path.getsize(p) for p in pre_segments}
+
+    wal2 = WAL(path, head_size_limit=128)
+    assert wal2.repair()
+    for p, sz in pre_sizes.items():
+        assert os.path.getsize(p) == sz  # rotated segments untouched
+    msgs = wal2.read_all()
+    assert msgs[-1].msg == MsgInfo("", b"good-tail")
+    # and the WAL keeps working after repair
+    wal2.write_sync(EndHeightMessage(9))
+    wal2.close()
+    tail, found = WAL(path, head_size_limit=128).search_for_end_height(9)
+    assert found and tail == []
+
+
+def test_wal_total_size_limit_drops_oldest(tmp_path):
+    import os
+
+    path = str(tmp_path / "wal")
+    wal = WAL(path, head_size_limit=128, total_size_limit=512)
+    for h in range(1, 41):
+        wal.write_sync(MsgInfo("", b"x" * 40))
+        wal.write_sync(EndHeightMessage(h))
+    wal.close()
+    segs = wal.segment_paths()
+    total = sum(os.path.getsize(p) for p in segs if os.path.exists(p))
+    assert total <= 512 + 256  # bounded (head may overshoot one record)
+    # the oldest heights are gone, the newest survive
+    heights = [m.msg.height for m in WAL(path, head_size_limit=128,
+                                         total_size_limit=512).read_all()
+               if isinstance(m.msg, EndHeightMessage)]
+    assert heights and heights[-1] == 40
+    assert heights[0] > 1
+    assert heights == list(range(heights[0], 41))
+
+
+def test_wal_corrupt_rotated_segment_keeps_valid_prefix(tmp_path):
+    """A flipped bit mid-segment must not erase the segment's valid
+    prefix from replay — the EndHeightMessage recovery needs may live
+    there."""
+    import os
+    import struct
+    import zlib
+
+    path = str(tmp_path / "wal")
+    wal = WAL(path, head_size_limit=128)
+    for h in range(1, 13):
+        wal.write(MsgInfo("", b"payload-%02d" % h))
+        wal.write_sync(EndHeightMessage(h))
+    wal.close()
+    segs = wal.segment_paths()
+    assert len(segs) >= 3
+    victim = segs[0]
+    # corrupt the crc of the LAST record in the oldest segment
+    data = open(victim, "rb").read()
+    frame = struct.Struct(">II")
+    pos = last = 0
+    while pos + frame.size <= len(data):
+        crc, ln = frame.unpack_from(data, pos)
+        if zlib.crc32(data[pos + frame.size:pos + frame.size + ln]) != crc:
+            break
+        last = pos
+        pos += frame.size + ln
+    corrupted = bytearray(data)
+    corrupted[last] ^= 0xFF
+    open(victim, "wb").write(bytes(corrupted))
+
+    wal2 = WAL(path, head_size_limit=128)
+    msgs = wal2.read_all()
+    heights = [m.msg.height for m in msgs
+               if isinstance(m.msg, EndHeightMessage)]
+    # only records at/after the corruption are lost; the valid prefix
+    # of the damaged segment and all newer segments survive
+    assert heights[-1] == 12
+    assert 1 in heights or heights[0] <= 2
+    # and search still finds markers that sit before the corruption
+    tail, found = wal2.search_for_end_height(heights[0])
+    assert found
