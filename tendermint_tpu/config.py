@@ -18,6 +18,9 @@ class BaseConfig:
     home: str = "."
     fast_sync: bool = True
     db_dir: str = "data"
+    # sqlite (ordered, disk-resident, range deletes — the tm-db
+    # analogue) | filedb (log-structured, memory-resident) | memdb
+    db_backend: str = "sqlite"
     genesis_file: str = "config/genesis.json"
     priv_validator_key_file: str = "config/priv_validator_key.json"
     priv_validator_state_file: str = "data/priv_validator_state.json"

@@ -922,8 +922,17 @@ class ConsensusState(Service):
                     peer_id, vote.validator_address.hex(),
                 )
                 continue
-            async with self._state_mtx:
-                await self._try_add_vote(vote, peer_id, preverified=True)
+            # Per-vote containment: once tallying has begun, one
+            # vote's commit failure must not throw the WHOLE batch to
+            # the degraded fallback — that would re-verify and
+            # re-report trust for votes already processed here.
+            try:
+                async with self._state_mtx:
+                    await self._try_add_vote(vote, peer_id,
+                                             preverified=True)
+            except Exception:
+                self.logger.exception(
+                    "dropping unprocessable vote from %r", peer_id)
         # Trust metric feedback on VERIFIED outcomes: credit good
         # lanes, debit rejected ones, disconnect on collapsed trust
         # (behaviour.py; a peer streaming well-formed-but-invalid
@@ -931,9 +940,14 @@ class ConsensusState(Service):
         rep = self.reporter_fn()
         if rep is not None:
             for peer_id, (good, bad) in per_peer.items():
-                rep.observe(peer_id, good=good, bad=bad)
-                if bad:
-                    await rep.enforce(peer_id, "invalid vote signature")
+                try:
+                    rep.observe(peer_id, good=good, bad=bad)
+                    if bad:
+                        await rep.enforce(peer_id,
+                                          "invalid vote signature")
+                except Exception:
+                    self.logger.exception(
+                        "trust feedback failed for %r", peer_id)
 
     async def _try_add_vote(self, vote: Vote, peer_id: str,
                             preverified: bool = False) -> bool:

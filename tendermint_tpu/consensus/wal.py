@@ -9,10 +9,13 @@ height (wal.go:42); recovery seeks the last one (wal.go:231)."""
 
 from __future__ import annotations
 
+import logging
 import os
 import struct
 import zlib
 from dataclasses import dataclass
+
+logger = logging.getLogger("wal")
 
 from ..encoding.proto import Reader, Writer
 
@@ -294,11 +297,9 @@ class WAL:
         very EndHeightMessage recovery is looking for), with a
         warning for the lost tail. The head's torn tail is expected
         (crash) and not warned about here; repair() handles it."""
-        import logging
-
         msgs, consumed, size = self._decode_file(path)
         if consumed < size and path != self.path:
-            logging.getLogger("wal").warning(
+            logger.warning(
                 "corrupt rotated WAL segment %s: %d of %d bytes "
                 "unreadable after record %d",
                 path, size - consumed, size, len(msgs))
@@ -319,37 +320,47 @@ class WAL:
         in-flight tail continues in the head. Segments are scanned
         NEWEST first and the scan stops at the first (newest) segment
         containing the marker, so boot cost is ~one segment, not the
-        whole group (the group can be 1 GiB)."""
+        whole group (the group can be 1 GiB). Two phases so the
+        marker-ABSENT case (a normal boot path after fast sync) holds
+        at most one decoded segment in memory at a time instead of
+        accumulating the whole group."""
         segs = self.segment_paths()
-        newer_tail: list[TimedWALMessage] = []
-        for p in reversed(segs):
-            msgs = self._read_segment(p)
-            idx = None
-            for i, m in enumerate(msgs):
-                if isinstance(m.msg, EndHeightMessage) and \
-                        m.msg.height == height:
-                    idx = i
-            if idx is not None:
-                return msgs[idx + 1:] + newer_tail, True
-            newer_tail = msgs + newer_tail
-        return [], False
+        found_seg = None
+        for si in range(len(segs) - 1, -1, -1):
+            if any(isinstance(m.msg, EndHeightMessage)
+                   and m.msg.height == height
+                   for m in self._read_segment(segs[si])):
+                found_seg = si
+                break
+        if found_seg is None:
+            return [], False
+        # Rebuild the tail: marker segment + everything newer. The
+        # common case (marker in the head) re-decodes one file.
+        tail: list[TimedWALMessage] = []
+        for si in range(found_seg, len(segs)):
+            msgs = self._read_segment(segs[si])
+            if si == found_seg:
+                idx = max(i for i, m in enumerate(msgs)
+                          if isinstance(m.msg, EndHeightMessage)
+                          and m.msg.height == height)
+                msgs = msgs[idx + 1:]
+            tail.extend(msgs)
+        return tail, True
 
     def repair(self) -> bool:
         """Truncate a corrupted tail of the HEAD segment in place,
         keeping every valid record (reference: consensus/state.go:2217
         repairWalFile — crashes only ever tear the file being
-        appended). Returns True if anything was cut."""
-        good = self.decode_all(self.path)
-        valid_bytes = 0
-        for m in good:
-            data = _encode_wal_msg(m)
-            valid_bytes += _FRAME.size + len(data)
-        actual = os.path.getsize(self.path)
-        if actual <= valid_bytes:
+        appended). Returns True if anything was cut. The cut point is
+        the decoder's consumed-bytes offset — the exact on-disk
+        boundary, independent of whether re-encoding would be
+        byte-identical."""
+        _, consumed, size = self._decode_file(self.path)
+        if size <= consumed:
             return False
         self._f.close()
         with open(self.path, "r+b") as f:
-            f.truncate(valid_bytes)
+            f.truncate(consumed)
         self._f = open(self.path, "ab")
-        self._head_size = valid_bytes
+        self._head_size = consumed
         return True

@@ -56,9 +56,7 @@ def default_app_creator(config: Config):
         if name in ("kvstore", "merkle-kvstore"):
             from ..abci.kvstore import MerkleKVStoreApp
 
-            data_dir = config.base.resolve(config.base.db_dir)
-            os.makedirs(data_dir, exist_ok=True)
-            db = FileDB(os.path.join(data_dir, "app.db"))
+            db = _db(config, "app", in_memory=False)
             cls = MerkleKVStoreApp if name == "merkle-kvstore" \
                 else PersistentKVStoreApp
             return ClientCreator(app=cls(
@@ -83,7 +81,32 @@ def _db(config: Config, name: str, in_memory: bool) -> DB:
         return MemDB()
     d = config.base.resolve(config.base.db_dir)
     os.makedirs(d, exist_ok=True)
-    return FileDB(os.path.join(d, f"{name}.db"))
+    backend = config.base.db_backend
+    if backend == "sqlite":
+        from ..libs.db import SqliteDB
+
+        sq_path = os.path.join(d, f"{name}.sqlite")
+        fdb_path = os.path.join(d, f"{name}.db")
+        db = SqliteDB(sq_path)
+        sq_empty = next(iter(db.iterate()), None) is None
+        if os.path.exists(fdb_path) and sq_empty:
+            # A pre-sqlite data dir: silently opening an empty store
+            # would restart the node from genesis while the privval
+            # state still holds signed heights — a bricked validator.
+            # Migrate the FileDB contents in, then shelve the old log.
+            logging.getLogger("node").warning(
+                "migrating %s -> %s (db_backend=sqlite)",
+                fdb_path, sq_path)
+            old = FileDB(fdb_path)
+            db.write_batch(list(old.iterate()))
+            old.close()
+            os.replace(fdb_path, fdb_path + ".migrated")
+        return db
+    if backend == "filedb":
+        return FileDB(os.path.join(d, f"{name}.db"))
+    if backend == "memdb":
+        return MemDB()
+    raise ValueError(f"unknown db_backend {backend!r}")
 
 
 class Node(Service):

@@ -79,3 +79,151 @@ def test_filedb_compaction(tmp_path):
     db2 = FileDB(path)
     assert db2.get(b"hot") == b"v199"
     db2.close()
+
+
+# --- SqliteDB: the ordered, disk-resident store (VERDICT r3 #8) -----------
+
+
+def test_sqlitedb_contract(tmp_path):
+    from tendermint_tpu.libs.db import SqliteDB
+
+    db = SqliteDB(str(tmp_path / "kv.sqlite"))
+    db.set(b"a", b"1")
+    db.set(b"b", b"2")
+    db.set(b"c", b"3")
+    assert db.get(b"b") == b"2"
+    assert db.get(b"zz") is None
+    db.set(b"b", b"2x")  # upsert
+    assert db.get(b"b") == b"2x"
+    db.delete(b"b")
+    assert db.get(b"b") is None and not db.has(b"b")
+    assert [k for k, _ in db.iterate()] == [b"a", b"c"]
+    db.write_batch([(b"a", None), (b"d", b"4")])
+    assert db.get(b"a") is None and db.get(b"d") == b"4"
+    db.close()
+
+
+def test_sqlitedb_persistence_and_order(tmp_path):
+    from tendermint_tpu.libs.db import SqliteDB
+
+    path = str(tmp_path / "kv.sqlite")
+    db = SqliteDB(path)
+    for i in range(1000):
+        db.set(b"H:%08d" % i, b"v%d" % i)
+    db.set(b"P:x", b"p")
+    db.close()
+    db2 = SqliteDB(path)
+    keys = [k for k, _ in db2.iterate_prefix(b"H:")]
+    assert keys == sorted(keys) and len(keys) == 1000
+    assert [k for k, _ in db2.iterate(b"H:00000997", b"H:00001000")] == [
+        b"H:00000997", b"H:00000998", b"H:00000999"]
+    # empty-value round trip (has() must still see it)
+    db2.set(b"empty", b"")
+    assert db2.get(b"empty") == b"" and db2.has(b"empty")
+    db2.close()
+
+
+def test_sqlitedb_range_prune_during_iteration(tmp_path):
+    """The pruning pattern: iterate a range while deleting inside it —
+    stateless pagination must not skip or crash."""
+    from tendermint_tpu.libs.db import SqliteDB
+
+    db = SqliteDB(str(tmp_path / "kv.sqlite"))
+    for i in range(2000):
+        db.set(b"B:%08d" % i, b"x" * 50)
+    seen = 0
+    for k, _ in db.iterate_prefix(b"B:"):
+        db.delete(k)
+        seen += 1
+    assert seen == 2000
+    assert [k for k, _ in db.iterate_prefix(b"B:")] == []
+    db.close()
+
+
+def test_sqlitedb_batch_atomicity(tmp_path):
+    from tendermint_tpu.libs.db import SqliteDB
+
+    db = SqliteDB(str(tmp_path / "kv.sqlite"))
+    db.set(b"x", b"old")
+
+    class Boom(Exception):
+        pass
+
+    def ops():
+        yield (b"x", b"new")
+        raise Boom
+
+    try:
+        db.write_batch(ops())
+    except Boom:
+        pass
+    # the half-applied batch rolled back
+    assert db.get(b"x") == b"old"
+    db.close()
+
+
+def test_sqlitedb_restart_cost_bounded_by_working_set(tmp_path):
+    """VERDICT r3 #8 done-bar: restart with a multi-thousand-height
+    history opens in bounded time/memory — no O(history) replay (the
+    FileDB failure mode this backend replaces)."""
+    import time
+
+    from tendermint_tpu.libs.db import SqliteDB
+
+    path = str(tmp_path / "big.sqlite")
+    db = SqliteDB(path)
+    blob = b"z" * 2000
+    ops = []
+    for h in range(5000):  # ~10 MB of history
+        ops.append((b"BS:H:%08d" % h, blob))
+        if len(ops) == 500:
+            db.write_batch(ops)
+            ops = []
+    db.write_batch(ops)
+    db.close()
+
+    t0 = time.perf_counter()
+    db2 = SqliteDB(path)
+    one = db2.get(b"BS:H:%08d" % 4999)
+    open_s = time.perf_counter() - t0
+    assert one == blob
+    # FileDB would replay ~10 MB through Python here; sqlite opens in
+    # milliseconds regardless of history size
+    assert open_s < 1.0, f"restart took {open_s:.2f}s"
+    # range prune of the oldest half happens in place
+    t0 = time.perf_counter()
+    dead = [(b"BS:H:%08d" % h, None) for h in range(2500)]
+    db2.write_batch(dead)
+    prune_s = time.perf_counter() - t0
+    assert prune_s < 5.0
+    assert db2.get(b"BS:H:%08d" % 0) is None
+    assert db2.get(b"BS:H:%08d" % 2500) == blob
+    db2.close()
+
+
+def test_filedb_to_sqlite_migration(tmp_path):
+    """A pre-sqlite data dir upgrades in place: _db() migrates the
+    FileDB contents into the sqlite store instead of silently opening
+    an empty one (which would restart a validator from genesis)."""
+    from tendermint_tpu.config import Config
+    from tendermint_tpu.node import _db
+
+    cfg = Config()
+    cfg.base.home = str(tmp_path)
+    # an old FileDB store with data
+    (tmp_path / "data").mkdir()
+    old = FileDB(str(tmp_path / "data" / "state.db"))
+    old.set(b"k1", b"v1")
+    old.set(b"k2", b"v2")
+    old.close()
+
+    db = _db(cfg, "state", in_memory=False)
+    assert db.get(b"k1") == b"v1" and db.get(b"k2") == b"v2"
+    db.set(b"k3", b"v3")
+    db.close()
+    assert os.path.exists(str(tmp_path / "data" / "state.db.migrated"))
+    assert not os.path.exists(str(tmp_path / "data" / "state.db"))
+    # idempotent: a second open does NOT re-migrate over new data
+    db2 = _db(cfg, "state", in_memory=False)
+    assert db2.get(b"k3") == b"v3"
+    db2.close()

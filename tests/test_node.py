@@ -142,8 +142,18 @@ def test_trust_metric_wired_into_live_node(tmp_path):
         finally:
             for n in nodes:
                 await n.stop()
-        trust_db = os.path.join(cfgs[0].base.home, "data", "trust.db")
-        assert os.path.exists(trust_db)
-        assert b"trusthistory" in open(trust_db, "rb").read()
+        data_dir = os.path.join(cfgs[0].base.home, "data")
+        trust_db = next(
+            (os.path.join(data_dir, f) for f in os.listdir(data_dir)
+             if f in ("trust.sqlite", "trust.db")), None)
+        assert trust_db is not None
+        # persisted history survives reopen, whatever the backend
+        from tendermint_tpu.libs.db import FileDB, SqliteDB
+
+        store = SqliteDB(trust_db) if trust_db.endswith(".sqlite") \
+            else FileDB(trust_db)
+        assert any(k.startswith(b"trusthistory")
+                   for k, _ in store.iterate())
+        store.close()
 
     run(go())
