@@ -27,6 +27,9 @@ class BaseConfig:
     node_key_file: str = "config/node_key.json"
     abci: str = "builtin"  # builtin | socket | grpc
     proxy_app: str = "kvstore"
+    # gate inbound conns/peers through ABCI /p2p/filter/... queries
+    # (reference config.BaseConfig.FilterPeers, node.go:432-466)
+    filter_peers: bool = False
     # builtin kvstore: take a state-sync snapshot every N heights
     # (0 = only advertise the live head; reference e2e app
     # snapshot_interval)

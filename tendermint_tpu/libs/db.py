@@ -151,10 +151,16 @@ class SqliteDB(DB):
                         "INSERT INTO kv (k, v) VALUES (?, ?) "
                         "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
                         (k, v))
+            # COMMIT inside the guard: if it fails (disk full, BUSY)
+            # the transaction must still be rolled back, or every
+            # later BEGIN dies with "transaction within a transaction"
+            self._c.execute("COMMIT")
         except BaseException:
-            self._c.execute("ROLLBACK")
+            try:
+                self._c.execute("ROLLBACK")
+            except Exception:
+                pass  # some COMMIT failures already ended the txn
             raise
-        self._c.execute("COMMIT")
 
     def iterate(self, start: bytes = b"", end: bytes | None = None):
         # Stateless pagination (fresh statement per page, resuming

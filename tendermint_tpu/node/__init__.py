@@ -94,9 +94,8 @@ def _db(config: Config, name: str, in_memory: bool) -> DB:
             # would restart the node from genesis while the privval
             # state still holds signed heights — a bricked validator.
             # Migrate the FileDB contents in, then shelve the old log.
-            logging.getLogger("node").warning(
-                "migrating %s -> %s (db_backend=sqlite)",
-                fdb_path, sq_path)
+            logger.warning("migrating %s -> %s (db_backend=sqlite)",
+                           fdb_path, sq_path)
             old = FileDB(fdb_path)
             db.write_batch(list(old.iterate()))
             old.close()
@@ -232,14 +231,42 @@ class Node(Service):
                 channels=bytes([0x00, 0x20, 0x21, 0x22, 0x23, 0x30,
                                 0x38, 0x40, 0x60, 0x61]))
 
+        # Inbound conn/peer filters (reference node.go:422-478):
+        # dup-IP at accept time unless allowed; ABCI-queried
+        # addr/id filters when base.filter_peers is on.
+        from ..p2p.conn_set import conn_duplicate_ip_filter
+
+        conn_filters = []
+        peer_filters = []
+        if not cfg.p2p.allow_duplicate_ip:
+            conn_filters.append(conn_duplicate_ip_filter)
+        if cfg.base.filter_peers:
+            # Both ABCI decisions (addr + id) happen post-handshake in
+            # one peer filter: conn filters here are sync and
+            # pre-handshake, so the addr query lands one hop later
+            # than the reference's — same accept/reject outcome.
+            async def abci_peer_filter(ni, socket_addr):
+                from ..abci import types as abci
+
+                for path in (f"/p2p/filter/addr/{socket_addr}",
+                             f"/p2p/filter/id/{ni.node_id}"):
+                    res = await self.proxy_app.query.query(
+                        abci.RequestQuery(path=path))
+                    if res.code != 0:
+                        return f"app rejected ({path}): code {res.code}"
+                return None
+
+            peer_filters.append(abci_peer_filter)
         self.transport = Transport(
             self.node_key, node_info,
             handshake_timeout=cfg.p2p.handshake_timeout_s,
-            dial_timeout=cfg.p2p.dial_timeout_s)
+            dial_timeout=cfg.p2p.dial_timeout_s,
+            conn_filters=conn_filters)
         holder["transport"] = self.transport
         self.switch = Switch(self.transport, node_info,
                              max_inbound=cfg.p2p.max_num_inbound_peers,
-                             max_outbound=cfg.p2p.max_num_outbound_peers)
+                             max_outbound=cfg.p2p.max_num_outbound_peers,
+                             peer_filters=peer_filters)
         # Peer-quality bookkeeping: EWMA trust metrics (persisted) fed
         # by reactor behaviour reports; collapsed trust disconnects
         # (behaviour.py, p2p/trust.py — reference behaviour/ + ADR-006)

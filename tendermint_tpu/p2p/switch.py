@@ -56,11 +56,17 @@ class SwitchError(Exception):
 class Switch(Service):
     def __init__(self, transport: Transport, node_info_fn,
                  mconn_config: MConnConfig | None = None,
-                 max_inbound: int = 40, max_outbound: int = 10):
+                 max_inbound: int = 40, max_outbound: int = 10,
+                 peer_filters: list | None = None):
         super().__init__(name="p2p.Switch")
         self.transport = transport
         self.node_info_fn = node_info_fn
         self.mconn_config = mconn_config
+        # Post-handshake peer filters (reference node.go:452
+        # PeerFilterFunc, e.g. ABCI /p2p/filter/id/<id> queries):
+        # async f(node_info, socket_addr) -> error string to reject,
+        # None to admit.
+        self.peer_filters = list(peer_filters or [])
         self.reactors: dict[str, Reactor] = {}
         self.chan_to_reactor: dict[int, Reactor] = {}
         self.channels: list[ChannelDescriptor] = []
@@ -107,9 +113,10 @@ class Switch(Service):
 
     async def _accept_routine(self) -> None:
         while True:
-            conn, ni = await self.transport.accept()
+            conn, ni, sock_addr = await self.transport.accept()
             try:
-                await self._add_peer(conn, ni, outbound=False)
+                await self._add_peer(conn, ni, outbound=False,
+                                     socket_addr=sock_addr)
             except Exception as e:
                 self.logger.info("rejected inbound peer %s: %s",
                                  ni.node_id[:12], e)
@@ -132,6 +139,10 @@ class Switch(Service):
         if outbound and not persistent and \
                 self._n_outbound() >= self.max_outbound:
             raise SwitchError("max outbound peers")
+        for f in self.peer_filters:
+            err = await f(ni, socket_addr)
+            if err is not None:
+                raise SwitchError(f"peer filtered: {err}")
         peer = Peer(conn, ni, self.channels,
                     on_receive=self._on_peer_receive,
                     on_error=self._on_peer_error,

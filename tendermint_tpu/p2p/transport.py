@@ -27,7 +27,10 @@ class Transport:
     def __init__(self, node_key: NodeKey, node_info_fn,
                  handshake_timeout: float = 20.0,
                  dial_timeout: float = 3.0,
-                 max_pending_handshakes: int = 64):
+                 max_pending_handshakes: int = 64,
+                 conn_filters: list | None = None):
+        from .conn_set import ConnSet
+
         # Pre-auth DoS bound: an attacker stalling mid-handshake holds a
         # slot for at most handshake_timeout; beyond the cap new dialers
         # are refused at accept, before any crypto work.
@@ -37,6 +40,11 @@ class Transport:
         self.node_info_fn = node_info_fn
         self.handshake_timeout = handshake_timeout
         self.dial_timeout = dial_timeout
+        # Inbound conn filters (reference transport_mconn.go filters +
+        # node.go:422-478 wiring): each is filter(conn_set, ip) and
+        # raises to refuse, BEFORE the handshake spends crypto.
+        self.conn_filters = list(conn_filters or [])
+        self.conn_set = ConnSet()
         self._server: asyncio.AbstractServer | None = None
         self._accept_queue: asyncio.Queue = asyncio.Queue(32)
 
@@ -55,21 +63,47 @@ class Transport:
         if self._handshake_slots.locked():
             writer.close()
             return
-        async with self._handshake_slots:
+        peername = writer.get_extra_info("peername")
+        ip = peername[0] if peername else ""
+        for f in self.conn_filters:
             try:
-                conn, ni = await asyncio.wait_for(
-                    self._upgrade(reader, writer), self.handshake_timeout)
+                f(self.conn_set, ip)
             except Exception:
                 writer.close()
                 return
+        # Track at FILTER time (keyed on the raw socket), as the
+        # reference does (transport.go filterConn → conns.Set): two
+        # simultaneous accepts from one IP must not both slip past
+        # the dup-IP check while neither is handshaken yet.
+        self.conn_set.add(writer, ip)
+        try:
+            async with self._handshake_slots:
+                conn, ni = await asyncio.wait_for(
+                    self._upgrade(reader, writer), self.handshake_timeout)
+        except Exception:
+            self.conn_set.remove(writer)
+            writer.close()
+            return
+        # Untrack on close, wherever the close happens (peer stop,
+        # queue shed, switch rejection) — conn.close() is the funnel.
+        orig_close = conn.close
+
+        def _close_untracked():
+            self.conn_set.remove(writer)
+            orig_close()
+
+        conn.close = _close_untracked
         try:
             # Never block holding an authenticated socket: if the Switch
             # isn't draining the queue, shed the newest connection.
-            self._accept_queue.put_nowait((conn, ni))
+            sock_addr = f"{ip}:{peername[1]}" if peername else ""
+            self._accept_queue.put_nowait((conn, ni, sock_addr))
         except asyncio.QueueFull:
             conn.close()
 
-    async def accept(self) -> tuple[SecretConnection, NodeInfo]:
+    async def accept(self) -> tuple[SecretConnection, NodeInfo, str]:
+        """Next authenticated inbound (conn, node_info, remote_addr) —
+        the remote addr feeds peer filters and peer bookkeeping."""
         return await self._accept_queue.get()
 
     async def dial(self, host: str, port: int) -> tuple[SecretConnection, NodeInfo]:
