@@ -352,13 +352,13 @@ class ConsensusReactor(Reactor):
                 return
             if isinstance(msg, m.ProposalMessage):
                 ps.set_proposal(msg.proposal)
-                self.cs.add_peer_msg(msg, peer.id)
+                await self.cs.add_peer_msg(msg, peer.id)
             elif isinstance(msg, m.ProposalPOLMessage):
                 ps.apply_proposal_pol(msg)
             elif isinstance(msg, m.BlockPartMessage):
                 ps.set_has_part(msg.height, msg.round, msg.part.index)
                 ps.block_parts_received += 1
-                self.cs.add_peer_msg(msg, peer.id)
+                await self.cs.add_peer_msg(msg, peer.id)
             else:
                 raise ValueError(f"bad msg on data channel: {type(msg)}")
         elif chan_id == VOTE_CHANNEL:
@@ -372,7 +372,7 @@ class ConsensusReactor(Reactor):
                 ps.set_has_vote(v.height, v.round, int(v.type),
                                 v.validator_index)
                 ps.votes_received += 1
-                self.cs.add_peer_msg(msg, peer.id)
+                await self.cs.add_peer_msg(msg, peer.id)
                 # NOTE: no trust credit here — votes are credited (or
                 # debited) by the state machine AFTER signature
                 # verification (state.py _verify_and_commit_batch);

@@ -1161,7 +1161,18 @@ class ConsensusState(Service):
 
     # -- public API (reactor / rpc) --
 
-    def add_peer_msg(self, msg, peer_id: str) -> None:
+    async def add_peer_msg(self, msg, peer_id: str) -> None:
+        """Blocks when the queue is full — backpressure onto the
+        calling peer's recv loop, matching the reference's
+        `cs.peerMsgQueue <- msgInfo` channel send (state.go:456).
+        Found by the 10k-validator scale test: a burst larger than
+        msgQueueSize must slow the sender down, not raise QueueFull
+        in the reactor."""
+        await self.peer_msg_queue.put(_QueuedMsg(msg, peer_id))
+
+    def add_peer_msg_nowait(self, msg, peer_id: str) -> None:
+        """Non-blocking variant for sync call sites (test hooks);
+        raises QueueFull instead of applying backpressure."""
         self.peer_msg_queue.put_nowait(_QueuedMsg(msg, peer_id))
 
     def get_round_state(self) -> RoundState:

@@ -206,26 +206,70 @@ class Runner:
                 continue
         return best
 
-    async def wait_net_height(self, h: int, timeout: float = 120.0) -> None:
-        deadline = time.monotonic() + timeout
-        while await self.net_height() < h:
-            if time.monotonic() > deadline:
-                raise TimeoutError(f"net never reached height {h}")
+    async def wait_net_height(self, h: int, timeout: float = 120.0,
+                              stall_timeout: float | None = None) -> None:
+        """Wait until the net's MAX height reaches h — progress-gated
+        like wait_all_height: only a stall (or the 4x-timeout cap)
+        fails, not a fixed deadline that suite load can blow."""
+        stall_timeout = stall_timeout or max(60.0, timeout / 2)
+        start = last_progress = time.monotonic()
+        best = -1
+        while True:
+            got = await self.net_height()
+            if got >= h:
+                return
+            now = time.monotonic()
+            if got > best:
+                best, last_progress = got, now
+            if now - last_progress > stall_timeout:
+                raise TimeoutError(
+                    f"net stalled at height {best} (target {h}) for "
+                    f"{stall_timeout:.0f}s")
+            if now - start > 4 * timeout:
+                raise TimeoutError(
+                    f"net did not reach {h} within {4 * timeout:.0f}s")
             await asyncio.sleep(0.25)
 
-    async def wait_all_height(self, h: int, timeout: float = 120.0) -> None:
-        deadline = time.monotonic() + timeout
-        for node in self.nodes:
-            while True:
+    async def wait_all_height(self, h: int, timeout: float = 120.0,
+                              stall_timeout: float | None = None) -> None:
+        """Wait for every node to reach height h. `timeout` bounds the
+        total wait, but the failure that actually matters is a STALL:
+        if any node keeps advancing we keep waiting (up to 4x timeout)
+        — on a single-core CI box under suite load a healthy net can
+        blow a fixed deadline while committing steadily."""
+        stall_timeout = stall_timeout or max(60.0, timeout / 2)
+        start = last_progress = time.monotonic()
+        best: dict[int, int] = {}
+        while True:
+            done = True
+            for node in self.nodes:
                 try:
-                    if await self.height_of(node) >= h:
-                        break
+                    got = await self.height_of(node)
                 except Exception:
-                    pass
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"node{node.index} never reached height {h}")
-                await asyncio.sleep(0.25)
+                    # unreachable RPC: a node that ALREADY reached the
+                    # target (e.g. killed by a later perturbation)
+                    # still counts as done
+                    got = best.get(node.index, 0)
+                    if got < h:
+                        done = False
+                    continue
+                if got > best.get(node.index, 0):
+                    best[node.index] = got
+                    last_progress = time.monotonic()
+                if got < h:
+                    done = False
+            if done:
+                return
+            now = time.monotonic()
+            if now - last_progress > stall_timeout:
+                raise TimeoutError(
+                    f"net stalled at heights {best} (target {h}) for "
+                    f"{stall_timeout:.0f}s")
+            if now - start > 4 * timeout:
+                raise TimeoutError(
+                    f"net did not reach {h} within {4 * timeout:.0f}s "
+                    f"(heights {best})")
+            await asyncio.sleep(0.25)
 
     # -- load (reference load.go) --
 

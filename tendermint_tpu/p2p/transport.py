@@ -138,3 +138,12 @@ class Transport:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+        # Drain queued-but-never-accepted authenticated conns: their
+        # sockets (and ConnSet entries, via the close funnel) would
+        # otherwise leak for the life of the process.
+        while True:
+            try:
+                conn, _, _ = self._accept_queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            conn.close()

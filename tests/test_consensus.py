@@ -77,11 +77,11 @@ def wire_network(nodes):
                 if j == i or dst.cs is None or not dst.cs.is_running:
                     continue
                 if event == "proposal":
-                    dst.cs.add_peer_msg(m.ProposalMessage(payload), f"n{i}")
+                    dst.cs.add_peer_msg_nowait(m.ProposalMessage(payload), f"n{i}")
                 elif event == "block_part":
-                    dst.cs.add_peer_msg(payload, f"n{i}")
+                    dst.cs.add_peer_msg_nowait(payload, f"n{i}")
                 elif event == "vote":
-                    dst.cs.add_peer_msg(m.VoteMessage(payload), f"n{i}")
+                    dst.cs.add_peer_msg_nowait(m.VoteMessage(payload), f"n{i}")
         src.cs.broadcast_hooks.append(hook)
 
 

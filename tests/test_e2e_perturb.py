@@ -64,7 +64,7 @@ def test_perturbations_full_run(tmp_path):
     logs = []
     runner = Runner(m, str(tmp_path / "net"), base_port=27300,
                     log=lambda s: logs.append(s))
-    report = asyncio.run(asyncio.wait_for(runner.run(), timeout=540))
+    report = asyncio.run(asyncio.wait_for(runner.run(), timeout=900))
     assert report["ok"] and report["nodes"] == 4
     assert report["txs_sent"] > 0
     assert len([ln for ln in logs if ln.startswith("perturb:")]) == 4
@@ -98,16 +98,20 @@ def test_maverick_in_subprocess_net(tmp_path):
         try:
             runner.setup()
             runner.start()
-            await runner.wait_all_height(m.wait_height, timeout=420)
+            await runner.wait_all_height(m.wait_height, timeout=200)
             report = await runner.check()
             assert report["ok"]
             # Evidence can land a few heights after the equivocation;
-            # keep polling new blocks until it shows (the net is still
-            # running).
-            deadline = _t.monotonic() + 60
+            # keep polling new blocks while the chain ADVANCES (under
+            # suite load blocks crawl — only a stalled chain fails).
             total = report["evidence_committed"]
-            while total == 0 and _t.monotonic() < deadline:
+            last_h, last_advance = 0, _t.monotonic()
+            while total == 0:
                 h = await runner.height_of(runner.nodes[0])
+                if h > last_h:
+                    last_h, last_advance = h, _t.monotonic()
+                elif _t.monotonic() - last_advance > 90:
+                    break  # chain stalled; give up and fail below
                 for height in range(1, h + 1):
                     b = await runner._rpc(runner.nodes[0], "block",
                                           height=height)
@@ -120,7 +124,7 @@ def test_maverick_in_subprocess_net(tmp_path):
         finally:
             runner.cleanup()
 
-    asyncio.run(asyncio.wait_for(go(), timeout=540))
+    asyncio.run(asyncio.wait_for(go(), timeout=1000))
 
 
 def test_late_statesync_node_joins(tmp_path):
@@ -139,7 +143,7 @@ def test_late_statesync_node_joins(tmp_path):
     logs = []
     runner = Runner(m, str(tmp_path / "net"), base_port=27700,
                     log=lambda s: logs.append(s))
-    report = asyncio.run(asyncio.wait_for(runner.run(), timeout=540))
+    report = asyncio.run(asyncio.wait_for(runner.run(), timeout=900))
     assert report["ok"] and report["nodes"] == 4
     assert any("late statesync node3" in ln for ln in logs)
     # the late node actually restored from a snapshot: its log says so

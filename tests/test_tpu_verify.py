@@ -144,6 +144,7 @@ def test_batch_verify_matches_oracle():
     assert want.sum() >= 10 and (~want).sum() >= 5, "need both classes"
 
 
+@pytest.mark.slow
 def test_batch_verify_randomized_against_oracle():
     rng = np.random.default_rng(42)
     pubs, msgs, sigs = [], [], []
@@ -176,6 +177,7 @@ def test_empty_batch():
     assert tv.verify_batch([], [], []).shape == (0,)
 
 
+@pytest.mark.slow
 def test_expanded_chunked_build_matches_single():
     """ExpandedKeys built in chunks (BUILD_CHUNK < V, bounding peak
     HBM at 10k keys) must gather the same table rows — verdicts match

@@ -185,6 +185,7 @@ class TestVerifyCommit:
         with pytest.raises(VerificationError, match="insufficient"):
             vs.verify_commit_light_trusting(CHAIN, commit, 2, 3)
 
+    @pytest.mark.slow
     def test_large_commit_batch(self):
         """150-validator commit — the light-client baseline config —
         routes through the expanded per-validator comb tables

@@ -61,7 +61,7 @@ def test_mixed_batch_rejected_lane():
             v2.signature = b"\x13" * 64  # corrupt
             v3, i3 = _prevote(node.cs, gdoc, pvs, 3)
             for v in (v1, v2, v3):
-                node.cs.add_peer_msg(m.VoteMessage(v), "peerX")
+                await node.cs.add_peer_msg(m.VoteMessage(v), "peerX")
             assert await _wait_tallied(node.cs, i1)
             assert await _wait_tallied(node.cs, i3)
             assert await _wait_tallied(node.cs, i2, want=False)
@@ -90,14 +90,14 @@ def test_device_failure_falls_back_to_sync_path():
             v1, i1 = _prevote(node.cs, gdoc, pvs, 1)
             v2, i2 = _prevote(node.cs, gdoc, pvs, 2)
             v2.signature = b"\x13" * 64  # still rejected on sync path
-            node.cs.add_peer_msg(m.VoteMessage(v1), "peerX")
-            node.cs.add_peer_msg(m.VoteMessage(v2), "peerX")
+            await node.cs.add_peer_msg(m.VoteMessage(v1), "peerX")
+            await node.cs.add_peer_msg(m.VoteMessage(v2), "peerX")
             assert await _wait_tallied(node.cs, i1)
             assert await _wait_tallied(node.cs, i2, want=False)
             # scheduler survived: a later (post-restore) vote verifies
             BatchVerifier.verify = orig
             v3, i3 = _prevote(node.cs, gdoc, pvs, 3)
-            node.cs.add_peer_msg(m.VoteMessage(v3), "peerX")
+            await node.cs.add_peer_msg(m.VoteMessage(v3), "peerX")
             assert await _wait_tallied(node.cs, i3)
         finally:
             BatchVerifier.verify = orig
@@ -117,8 +117,8 @@ def test_duplicate_suppression():
         try:
             v1, i1 = _prevote(node.cs, gdoc, pvs, 1)
             # same-vote twice in one window: one tally, no error
-            node.cs.add_peer_msg(m.VoteMessage(v1), "pA")
-            node.cs.add_peer_msg(m.VoteMessage(v1), "pB")
+            await node.cs.add_peer_msg(m.VoteMessage(v1), "pA")
+            await node.cs.add_peer_msg(m.VoteMessage(v1), "pB")
             assert await _wait_tallied(node.cs, i1)
             await asyncio.sleep(0.05)  # let the batch fully drain
             # re-gossip after commit: suppressed before the buffer
@@ -141,7 +141,7 @@ def test_replay_mode_bypasses_scheduler():
         try:
             node.cs._replay_mode = True
             v1, i1 = _prevote(node.cs, gdoc, pvs, 1)
-            node.cs.add_peer_msg(m.VoteMessage(v1), "")
+            await node.cs.add_peer_msg(m.VoteMessage(v1), "")
             assert await _wait_tallied(node.cs, i1)
             assert node.cs._vote_buf == [], \
                 "replay-mode vote went through the async scheduler"
@@ -177,8 +177,8 @@ def test_batch_verdicts_feed_trust_metric():
             v1, i1 = _prevote(node.cs, gdoc, pvs, 1)
             v2, i2 = _prevote(node.cs, gdoc, pvs, 2)
             v2.signature = b"\x13" * 64
-            node.cs.add_peer_msg(m.VoteMessage(v1), "goodpeer")
-            node.cs.add_peer_msg(m.VoteMessage(v2), "badpeer")
+            await node.cs.add_peer_msg(m.VoteMessage(v1), "goodpeer")
+            await node.cs.add_peer_msg(m.VoteMessage(v2), "badpeer")
             assert await _wait_tallied(node.cs, i1)
             assert await _wait_tallied(node.cs, i2, want=False)
             for _ in range(100):
