@@ -744,8 +744,21 @@ class ConsensusState(Service):
         if added and rs.proposal_block_parts.is_complete():
             data = rs.proposal_block_parts.assemble()
             block = Block.from_bytes(data)
-            if rs.proposal is not None and block.hash() != rs.proposal.block_id.hash:
-                raise VoteSetError("completed block hash != proposal block id")
+            # The part-set header (each part merkle-proven into it) is
+            # the authoritative identity of what we accepted. Compare
+            # against the proposal only when the proposal refers to
+            # THIS part set: during commit-time catch-up the parts
+            # carry the DECIDED block (header installed by
+            # _enter_commit from the +2/3 block id), which legitimately
+            # differs from a stale earlier-round proposal — rejecting
+            # it wedged a late-joining node behind a racing net for
+            # good (found by the statesync e2e under suite load).
+            if (rs.proposal is not None and
+                    rs.proposal_block_parts.has_header(
+                        rs.proposal.block_id.part_set_header) and
+                    block.hash() != rs.proposal.block_id.hash):
+                raise VoteSetError(
+                    "completed block hash != proposal block id")
             rs.proposal_block = block
             if self.event_bus is not None:
                 self.event_bus.publish_complete_proposal(EventDataRoundState(

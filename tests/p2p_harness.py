@@ -134,24 +134,15 @@ async def make_net(n, wait_sync_last=False):
 
 async def wait_for_height_progress(nodes, target_h,
                                    stall_timeout=120.0, cap=900.0):
-    """Wait until every node reaches target_h, failing only on a real
-    STALL (no height/round movement anywhere for stall_timeout) or an
-    absolute cap — not on a fixed deadline that single-core suite
-    load can blow through (VERDICT r3 weak #4)."""
-    import time as _time
+    """Every node reaches target_h, failing only on a real STALL (no
+    height/round movement anywhere) or the absolute cap — shared
+    progress-gated implementation (e2e/runner.wait_progress)."""
+    from tendermint_tpu.e2e.runner import wait_progress
 
-    start = last_change = _time.monotonic()
-    last_view = None
-    while True:
-        view = tuple((n.cs.rs.height, n.cs.rs.round) for n in nodes)
-        if all(h >= target_h for h, _ in view):
-            return
-        now = _time.monotonic()
-        if view != last_view:
-            last_view, last_change = view, now
-        if now - last_change > stall_timeout:
-            raise TimeoutError(
-                f"net stalled at {view} for {stall_timeout}s")
-        if now - start > cap:
-            raise TimeoutError(f"net did not reach {target_h} in {cap}s")
-        await asyncio.sleep(0.25)
+    async def sample():
+        return tuple((n.cs.rs.height, n.cs.rs.round) for n in nodes)
+
+    await wait_progress(
+        sample, lambda view: all(h >= target_h for h, _ in view),
+        timeout=cap / 4, stall_timeout=stall_timeout,
+        what=f"all in-process nodes at height {target_h}")

@@ -247,3 +247,52 @@ def test_handshake_app_ahead_of_state(tmp_path):
         await conns.stop()
 
     asyncio.run(go())
+
+
+def test_catchup_parts_complete_despite_stale_proposal(tmp_path):
+    """Commit-time catch-up regression (found by the statesync e2e
+    under suite load): a node holding a STALE proposal for round-0
+    block A receives, at commit time, the parts of the DECIDED block
+    B (part set re-initialized by _enter_commit from the +2/3 block
+    id). Completion must be judged against the part-set header, not
+    the unrelated proposal — the old check rejected the decided block
+    and wedged the late joiner behind the net permanently."""
+    async def go():
+        from tendermint_tpu.consensus import messages as m
+        from tendermint_tpu.types.block import BlockID, PartSet
+        from tendermint_tpu.types.proposal import Proposal
+
+        gdoc, pvs = make_genesis(1)
+        node = Node(gdoc, pvs[0])
+        await node.start()
+        try:
+            await node.cs.wait_for_height(2, timeout=30)
+            cs = node.cs
+            rs = cs.rs
+            # block B: a real decodable block (reuse block 1 content,
+            # it only needs to assemble; completion happens before
+            # height checks)
+            bs = BlockStore(node.block_db)
+            block_b = bs.load_block(1)
+            ps_b = block_b.make_part_set(128)
+            # stale proposal for a DIFFERENT block id / part set
+            rs.proposal = Proposal(
+                height=rs.height, round=0, pol_round=-1,
+                block_id=BlockID(
+                    b"\xaa" * 32,
+                    type(ps_b.header())(total=1, hash=b"\xbb" * 32)),
+            )
+            # _enter_commit's reinit: accept B's part set
+            rs.proposal_block = None
+            rs.proposal_block_parts = PartSet(ps_b.total, ps_b.hash)
+            for i in range(ps_b.total):
+                added = cs._add_proposal_block_part(
+                    m.BlockPartMessage(rs.height, rs.round,
+                                       ps_b.get_part(i)))
+                assert added
+            assert rs.proposal_block is not None
+            assert rs.proposal_block.hash() == block_b.hash()
+        finally:
+            await node.stop()
+
+    asyncio.run(go())
