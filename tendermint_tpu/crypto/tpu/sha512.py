@@ -74,6 +74,24 @@ def pad_messages(msgs: list[bytes], prefix_len: int = 0) -> tuple[np.ndarray, np
     nblocks = (total_lens + 1 + 16 + 127) // 128
     max_blocks = int(nblocks.max()) if n else 1
     width = max_blocks * 128 - prefix_len
+    if n >= 256:
+        # Native fast path (tendermint_tpu/native/pack.c): one C pass
+        # replaces the numpy scatter/group fill AND the tail writes —
+        # host packing serializes ahead of the launch, so this sits
+        # directly on the commit-latency budget.
+        from ...native import lib as _native_lib
+
+        L = _native_lib()
+        if L is not None:
+            flat = np.frombuffer(b"".join(msgs), np.uint8)
+            starts = np.zeros(n, np.int64)
+            np.cumsum(lens[:-1], out=starts[1:])
+            out = np.zeros((n, width), np.uint8)
+            nb = np.empty(n, np.int64)
+            L.tm_pack_pad(flat, starts, np.ascontiguousarray(lens),
+                          n, width, prefix_len, out, nb)
+            return out, nb.astype(np.int32)  # same dtype as the
+            # numpy path below (compress_blocks' (N,) int32 contract)
     out = np.zeros((n, width), np.uint8)
     uniq = np.unique(lens) if n else lens
     if n and uniq.size <= 8:

@@ -33,6 +33,16 @@ import numpy as np
 
 from .. import ed25519_ref as ref
 
+# Warm the native packer at import (node/verifier startup): the
+# build-on-first-use cc subprocess must never run lazily inside a
+# commit verify — that path has a <5 ms budget.
+try:
+    from ...native import lib as _native_lib
+
+    _native_lib()
+except Exception:  # pragma: no cover - never block import on this
+    pass
+
 _L = ref.L
 _MAX_BATCH = 1 << 15
 _MIN_BATCH = 1 << 7

@@ -1,0 +1,54 @@
+"""Native host-packing fast path (tendermint_tpu/native/pack.c):
+byte-exact parity with the numpy reference across message shapes, and
+a clean numpy fallback when no C compiler is available."""
+
+import random
+
+import numpy as np
+
+from tendermint_tpu import native
+from tendermint_tpu.crypto.tpu import sha512 as sh
+
+
+def _numpy_pad(msgs, prefix_len):
+    """Force the numpy path regardless of batch size."""
+    out_rows = []
+    nbs = []
+    for s in range(0, len(msgs), 255):  # < native threshold
+        o, nb = sh.pad_messages(msgs[s:s + 255], prefix_len=prefix_len)
+        out_rows.append(o)
+        nbs.append(nb)
+    width = max(o.shape[1] for o in out_rows)
+    full = np.zeros((len(msgs), width), np.uint8)
+    at = 0
+    for o in out_rows:
+        full[at:at + o.shape[0], :o.shape[1]] = o
+        at += o.shape[0]
+    return full, np.concatenate(nbs)
+
+
+def test_native_pack_parity():
+    if native.lib() is None:
+        import pytest
+
+        pytest.skip("no C compiler in this environment")
+    random.seed(11)
+    msgs = [bytes(random.randrange(256) for _ in range(
+        random.choice([0, 1, 40, 63, 64, 65, 111, 127, 200, 500])))
+        for _ in range(700)]
+    got, got_nb = sh.pad_messages(msgs, prefix_len=64)  # native (>=256)
+    want, want_nb = _numpy_pad(msgs, prefix_len=64)
+    assert (got_nb == want_nb).all()
+    w = min(got.shape[1], want.shape[1])
+    assert (got[:, :w] == want[:, :w]).all()
+    assert not got[:, w:].any() and not want[:, w:].any()
+
+
+def test_numpy_fallback_when_native_missing(monkeypatch):
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)  # lib() -> None
+    msgs = [b"m%03d" % i for i in range(300)]
+    out, nb = sh.pad_messages(msgs, prefix_len=64)
+    assert out.shape[0] == 300 and (nb == 1).all()
+    # terminator + bit length present
+    assert out[0, 4] == 0x80
