@@ -63,10 +63,24 @@ class LightProxy:
             "health": self.health,
         }
         if self.forward is not None:
+            # verified pass-throughs (reference light/rpc/client.go):
+            # the answer is checked against light-verified state
             routes["abci_query"] = self.abci_query
+            routes["block_by_hash"] = self.block_by_hash
+            routes["block_results"] = self.block_results
+            routes["tx"] = self.tx
+            routes["blockchain"] = self.blockchain
+            routes["consensus_params"] = self.consensus_params
+            # plain pass-throughs — the set the reference also relays
+            # without light verification (lrpc client delegates these
+            # straight to `next`)
             for name in ("broadcast_tx_sync", "broadcast_tx_async",
                          "broadcast_tx_commit", "abci_info",
-                         "tx", "tx_search", "net_info",
+                         "tx_search", "net_info",
+                         "genesis", "genesis_chunked", "block_search",
+                         "consensus_state", "dump_consensus_state",
+                         "unconfirmed_txs",
+                         "num_unconfirmed_txs", "check_tx",
                          "broadcast_evidence"):
                 routes[name] = self._forwarder(name)
         return routes
@@ -158,6 +172,224 @@ class LightProxy:
                 f"primary served block {got.hex()[:16]}… but the "
                 f"verified header at height {lb.height()} is "
                 f"{want.hex()[:16]}… — refusing to relay a forged block")
+        # and the BODY must actually hash to that id (a forged body
+        # under a truthful block_id must not pass)
+        self._check_block_body(res, want)
+        return res
+
+    def _check_block_body(self, res: dict, want: bytes) -> None:
+        """The served BODY must hash to `want`: recompute the header
+        hash from the response (not the primary's claimed block_id)
+        and bind the tx payload to header.data_hash — a primary
+        cannot attach a forged body under a real verified hash
+        (reference client.go BlockByHash res.Block.ValidateBasic +
+        Hash comparison)."""
+        import base64
+
+        from ..crypto import merkle
+        from ..rpc.core import header_from_json
+
+        hdr = header_from_json(res["block"]["header"])
+        if hdr.hash() != want:
+            raise RPCError(
+                -32603,
+                f"served block body hashes to {hdr.hash().hex()[:16]}… "
+                f"not the verified {want.hex()[:16]}…")
+        txs = [base64.b64decode(t)
+               for t in res["block"]["data"].get("txs") or []]
+        if merkle.hash_from_byte_slices(txs) != hdr.data_hash:
+            raise RPCError(
+                -32603, "served txs do not match the header's data_hash")
+
+    async def block_by_hash(self, ctx, hash="") -> dict:
+        """reference light/rpc/client.go:314 BlockByHash: the answer
+        must be the block WE asked for (requested hash), its body must
+        hash to that id, and the id must equal the light-verified
+        header at that height."""
+        if self.forward is None:
+            raise RPCError(-32601, "pass-through not configured")
+        from ..rpc.core import coerce_hex_param
+
+        hash = coerce_hex_param(hash)
+        want = bytes.fromhex(hash)
+        res = await self.forward.call("block_by_hash", hash=hash)
+        h = int(res["block"]["header"]["height"])
+        self._check_block_body(res, want)
+        # the relayed block_id must be the verified id too — clients
+        # record it as the canonical hash
+        if bytes.fromhex(res["block_id"]["hash"]) != want:
+            raise RPCError(
+                -32603, "block_id does not match the requested hash")
+        lb = await self._verified_block_at(h)
+        if want != lb.hash():
+            raise RPCError(
+                -32603,
+                f"block {want.hex()[:16]}… at height {h} does not "
+                f"match the verified header {lb.hash().hex()[:16]}…")
+        return res
+
+    async def block_results(self, ctx, height=None) -> dict:
+        """reference light/rpc/client.go:349 BlockResults: recompute
+        the deliver-tx results hash from the response and check it
+        against header(h+1).last_results_hash — tampered tx results
+        (codes/data) are rejected."""
+        import base64
+        from types import SimpleNamespace
+
+        if self.forward is None:
+            raise RPCError(-32601, "pass-through not configured")
+        if height in (None, 0, "0", ""):
+            # latest results aren't provable yet (their hash lands in
+            # the NEXT header) — serve the previous block's instead,
+            # as the reference does (client.go:352-358)
+            st = await self.forward.call("status")
+            height = int(st["sync_info"]["latest_block_height"]) - 1
+        res = await self.forward.call("block_results", height=height)
+        h = int(height)
+        if h <= 0:
+            raise RPCError(-32603, "zero or negative results height")
+        if int(res.get("height") or 0) != h:
+            # verification is against the REQUESTED height; an answer
+            # for some other height must not slip through
+            raise RPCError(
+                -32603,
+                f"primary answered for height {res.get('height')} but "
+                f"{h} was requested")
+        lb = await self._verified_block_at(h + 1)
+        from ..state import abci_results_hash
+
+        rs = [SimpleNamespace(
+            code=int(t.get("code", 0)),
+            data=base64.b64decode(t.get("data") or ""))
+            for t in res.get("txs_results") or []]
+        want = lb.signed_header.header.last_results_hash
+        if abci_results_hash(rs) != want:
+            raise RPCError(
+                -32603,
+                f"results hash mismatch for height {h} — refusing to "
+                "relay tampered block results")
+        return res
+
+    async def tx(self, ctx, hash="", prove=True) -> dict:
+        """reference light/rpc/client.go:425 Tx: prove is forced on
+        and the tx merkle proof is validated against the verified
+        header's data_hash."""
+        import base64
+
+        if self.forward is None:
+            raise RPCError(-32601, "pass-through not configured")
+        from ..crypto import tmhash
+        from ..rpc.core import coerce_hex_param
+
+        hash = coerce_hex_param(hash)
+        res = await self.forward.call("tx", hash=hash, prove=True)
+        h = int(res["height"])
+        if h <= 0:
+            raise RPCError(-32603, "zero or negative tx height")
+        proof = res.get("proof")
+        if not proof:
+            raise RPCError(-32603, "no proof in tx response")
+        txb = base64.b64decode(res.get("tx") or "")
+        # the proven tx must BE the one we asked for — an honest
+        # inclusion proof for a different committed tx must not pass
+        if tmhash.sum256(txb) != bytes.fromhex(hash):
+            raise RPCError(
+                -32603,
+                f"primary answered with a tx hashing to "
+                f"{tmhash.sum256(txb).hex()[:16]}… but {hash[:16]}… "
+                "was queried")
+        lb = await self._verified_block_at(h)
+        from ..crypto import merkle
+
+        pj = proof["proof"]
+        p = merkle.Proof(
+            total=int(pj["total"]), index=int(pj["index"]),
+            leaf_hash=base64.b64decode(pj["leaf_hash"]),
+            aunts=[base64.b64decode(a) for a in pj.get("aunts", [])])
+        if not p.verify(lb.signed_header.header.data_hash, txb):
+            raise RPCError(
+                -32603,
+                f"tx proof failed against data_hash of verified "
+                f"header {h} — refusing to relay")
+        return res
+
+    async def blockchain(self, ctx, min_height=None,
+                         max_height=None) -> dict:
+        """reference lrpc client BlockchainInfo: every returned
+        BlockMeta's header must recompute to its claimed block id and
+        match the light-verified header at that height."""
+        if self.forward is None:
+            raise RPCError(-32601, "pass-through not configured")
+        from ..rpc.core import header_from_json
+
+        res = await self.forward.call(
+            "blockchain", min_height=min_height, max_height=max_height)
+        lo = int(min_height) if min_height not in (None, "", "0", 0) \
+            else None
+        hi = int(max_height) if max_height not in (None, "", "0", 0) \
+            else None
+        for meta in res.get("block_metas") or []:
+            hdr = header_from_json(meta["header"])
+            # answers must stay inside the requested range — a
+            # different (individually valid) range must not pass
+            if (lo is not None and hdr.height < lo) or \
+                    (hi is not None and hdr.height > hi):
+                raise RPCError(
+                    -32603,
+                    f"block meta height {hdr.height} outside the "
+                    f"requested range [{min_height}, {max_height}]")
+            want = bytes.fromhex(meta["block_id"]["hash"])
+            if hdr.hash() != want:
+                raise RPCError(
+                    -32603,
+                    f"block meta at height {hdr.height}: header does "
+                    "not hash to its claimed block id")
+            lb = await self._verified_block_at(hdr.height)
+            if lb.hash() != want:
+                raise RPCError(
+                    -32603,
+                    f"block meta at height {hdr.height} does not match "
+                    "the verified header")
+        return res
+
+    async def consensus_params(self, ctx, height=None) -> dict:
+        """reference lrpc client ConsensusParams: the returned params
+        must hash to the verified header's consensus_hash."""
+        if self.forward is None:
+            raise RPCError(-32601, "pass-through not configured")
+        from ..types.params import (BlockParams, ConsensusParams,
+                                    EvidenceParams, ValidatorParams,
+                                    VersionParams)
+
+        res = await self.forward.call("consensus_params", height=height)
+        h = int(res["block_height"])
+        if height not in (None, 0, "0", "") and h != int(height):
+            raise RPCError(
+                -32603,
+                f"primary answered params for height {h} but "
+                f"{height} was requested")
+        cp = res["consensus_params"]
+        params = ConsensusParams(
+            block=BlockParams(
+                max_bytes=int(cp["block"]["max_bytes"]),
+                max_gas=int(cp["block"]["max_gas"])),
+            evidence=EvidenceParams(
+                max_age_num_blocks=int(
+                    cp["evidence"]["max_age_num_blocks"]),
+                max_age_duration_ns=int(
+                    cp["evidence"]["max_age_duration"]),
+                max_bytes=int(cp["evidence"]["max_bytes"])),
+            validator=ValidatorParams(
+                pub_key_types=list(cp["validator"]["pub_key_types"])),
+            version=VersionParams(app_version=int(
+                (cp.get("version") or {}).get("app_version", 0))),
+        )
+        lb = await self._verified_block_at(h)
+        if params.hash() != lb.signed_header.header.consensus_hash:
+            raise RPCError(
+                -32603,
+                f"consensus params do not hash to the verified "
+                f"header {h}'s consensus_hash — refusing to relay")
         return res
 
     async def abci_query(self, ctx, path="", data="", height=0,

@@ -337,6 +337,8 @@ class Environment:
                     "max_bytes": str(params.evidence.max_bytes)},
                 "validator": {
                     "pub_key_types": params.validator.pub_key_types},
+                "version": {
+                    "app_version": str(params.version.app_version)},
             },
         }
 

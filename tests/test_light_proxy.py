@@ -137,6 +137,7 @@ def test_proxy_verified_abci_query(tmp_path):
                 tx=base64.b64encode(b"pk=pv").decode())
             assert res["deliver_tx"]["code"] == 0
             tx_height = int(res["height"])
+            tx_hash = res["hash"]
             # the proof verifies against header(h+1).app_hash — wait
             # for it to exist
             await node.consensus_state.wait_for_height(
@@ -225,6 +226,92 @@ def test_proxy_verified_abci_query(tmp_path):
                 q = await http.call("abci_query", data=b"ek".hex())
                 assert q["response"]["value"] in ("", None)
                 assert q["response"]["log"] == "exists"
+
+                # verified tx: proof against the header's data_hash
+                txr = await http.call("tx", hash=res["hash"])
+                assert base64.b64decode(txr["tx"]) == b"ek="
+                # verified block_by_hash round trip
+                meta = await http_node.call("block", height=tx_height)
+                bbh = await http.call("block_by_hash",
+                                      hash=meta["block_id"]["hash"])
+                assert int(bbh["block"]["header"]["height"]) == tx_height
+                # verified block_results: honest passes...
+                br = await http.call("block_results", height=tx_height)
+                assert br["txs_results"][0]["code"] == 0
+
+                class TamperResults:
+                    def __init__(self, inner):
+                        self.inner = inner
+
+                    async def call(self, name, **params):
+                        res = await self.inner.call(name, **params)
+                        if name == "block_results":
+                            res["txs_results"][0]["data"] = \
+                                base64.b64encode(b"evil").decode()
+                        return res
+
+                proxy2 = LightProxy(
+                    cl, forward_client=TamperResults(http_node))
+                # ...tampered deliver-tx data is rejected
+                with pytest.raises(RPCError,
+                                   match="results hash mismatch"):
+                    await proxy2.block_results(None, height=tx_height)
+
+                # verified blockchain: metas check out against the
+                # light-verified headers
+                bc = await http.call("blockchain", min_height=1,
+                                     max_height=tx_height)
+                assert len(bc["block_metas"]) == tx_height
+                # verified consensus_params: hash pinned to the header
+                cp = await http.call("consensus_params",
+                                     height=tx_height)
+                assert int(cp["consensus_params"]["block"]
+                           ["max_bytes"]) > 0
+
+                class TamperParams:
+                    def __init__(self, inner):
+                        self.inner = inner
+
+                    async def call(self, name, **params):
+                        res = await self.inner.call(name, **params)
+                        if name == "consensus_params":
+                            res["consensus_params"]["block"][
+                                "max_bytes"] = "12345"
+                        elif name == "blockchain":
+                            res["block_metas"][0]["header"][
+                                "app_hash"] = "ee" * 32
+                        return res
+
+                proxy3 = LightProxy(
+                    cl, forward_client=TamperParams(http_node))
+                with pytest.raises(RPCError, match="consensus_hash"):
+                    await proxy3.consensus_params(None,
+                                                  height=tx_height)
+                with pytest.raises(RPCError, match="block id"):
+                    await proxy3.blockchain(None, min_height=1,
+                                            max_height=2)
+                # substituted tx (honest proof, wrong subject) rejected
+                class TamperTx:
+                    def __init__(self, inner):
+                        self.inner = inner
+
+                    async def call(self, name, **params):
+                        if name == "tx":
+                            # answer with a DIFFERENT committed tx
+                            return await self.inner.call(
+                                "tx", hash=res2_hash, prove=True)
+                        return await self.inner.call(name, **params)
+
+                res2 = await http_node.call(
+                    "broadcast_tx_commit",
+                    tx=base64.b64encode(b"other=tx").decode())
+                res2_hash = res2["hash"]
+                await node.consensus_state.wait_for_height(
+                    int(res2["height"]) + 2, timeout=60)
+                proxy4 = LightProxy(cl,
+                                    forward_client=TamperTx(http_node))
+                with pytest.raises(RPCError, match="was queried"):
+                    await proxy4.tx(None, hash=tx_hash)
             finally:
                 proxy.close()
         finally:
