@@ -41,7 +41,9 @@ class LightProxy:
         # (reference: lrpc.KeyPathFn/prt options); default knows the
         # kvstore ops, apps with their own formats inject a runtime
         self._prt = proof_runtime
-        self.server = JSONRPCServer(self._routes())
+        self.server = JSONRPCServer(self._routes(),
+                                    ws_routes=self._ws_routes())
+        self.server._on_ws_close = self._on_ws_close
         self.port: int | None = None
 
     async def listen(self, host: str, port: int) -> int:
@@ -485,6 +487,77 @@ class LightProxy:
 
             self._prt = kv_proof_runtime()
         return self._prt
+
+    # -- websocket subscriptions (reference light/proxy/routes.go
+    #    subscribe/unsubscribe: relayed through the primary's event
+    #    stream; events are inherently unverifiable live data, same
+    #    trust level as the reference's passthrough) --
+
+    def _ws_routes(self) -> dict:
+        if self.forward is None or not hasattr(self.forward, "host"):
+            return {}
+        return {"subscribe": self.subscribe,
+                "unsubscribe": self.unsubscribe,
+                "unsubscribe_all": self.unsubscribe_all}
+
+    MAX_SUBSCRIPTIONS_PER_CLIENT = 5  # same bound as RPCConfig
+
+    async def subscribe(self, ctx, query="") -> dict:
+        import asyncio
+
+        from ..rpc.jsonrpc import WSClient, relay_events
+
+        ws = ctx.ws
+        if ws is None:
+            raise RPCError(-32603, "subscribe requires a websocket")
+        subs = getattr(ws, "_lp_subs", None)
+        if subs is None:
+            subs = ws._lp_subs = {}
+        if query in subs:
+            raise RPCError(-32603, f"already subscribed to {query!r}")
+        if len(subs) >= self.MAX_SUBSCRIPTIONS_PER_CLIENT:
+            # each subscription costs an upstream TCP+WS connection;
+            # an unbounded loop over distinct queries must not
+            # exhaust fds on proxy or primary
+            raise RPCError(-32603, "too many subscriptions")
+        up = WSClient(self.forward.host, self.forward.port)
+        try:
+            # bounded: the handler runs inline in the ws read loop, so
+            # a blackholed primary must not wedge this client's socket
+            await asyncio.wait_for(up.connect(), 10)
+            await up.call("subscribe", query=query)
+        except BaseException:
+            up.close()
+            raise
+        task = asyncio.get_running_loop().create_task(
+            relay_events(ws, up.events.get), name=f"lp-ws-sub-{id(ws)}")
+        subs[query] = (up, task)
+        return {}
+
+    async def unsubscribe(self, ctx, query="") -> dict:
+        ws = ctx.ws
+        subs = getattr(ws, "_lp_subs", {}) if ws else {}
+        ent = subs.pop(query, None)
+        if ent is None:
+            raise RPCError(-32603, f"not subscribed to {query!r}")
+        up, task = ent
+        task.cancel()
+        up.close()
+        return {}
+
+    async def unsubscribe_all(self, ctx) -> dict:
+        ws = ctx.ws
+        for up, task in getattr(ws, "_lp_subs", {}).values():
+            task.cancel()
+            up.close()
+        if ws is not None:
+            ws._lp_subs = {}
+        return {}
+
+    def _on_ws_close(self, ws) -> None:
+        for up, task in getattr(ws, "_lp_subs", {}).values():
+            task.cancel()
+            up.close()
 
     # -- pass-through routes --
 

@@ -640,28 +640,20 @@ class Environment:
         subscriber = f"{_SUBSCRIBER_PREFIX}{id(ws)}-{self._next_sub}"
         sub = self.node.event_bus.subscribe(subscriber, q)
 
-        async def pump():
-            while True:
-                try:
-                    msg = await sub.next()
-                except asyncio.CancelledError:
-                    return
-                ws.send_json({
-                    "jsonrpc": "2.0", "id": None,
-                    "result": {"query": query,
-                               "data": _event_json(msg.data),
-                               "events": msg.attrs},
-                })
-                try:
-                    # backpressure: a subscriber that stops reading must
-                    # not buffer block JSON in memory forever
-                    await asyncio.wait_for(ws.writer.drain(), 30)
-                except (asyncio.TimeoutError, ConnectionError):
-                    ws.close()
-                    return
+        async def next_notification():
+            msg = await sub.next()
+            return {
+                "jsonrpc": "2.0", "id": None,
+                "result": {"query": query,
+                           "data": _event_json(msg.data),
+                           "events": msg.attrs},
+            }
+
+        from .jsonrpc import relay_events
 
         task = asyncio.get_running_loop().create_task(
-            pump(), name=f"ws-sub-{subscriber}")
+            relay_events(ws, next_notification),
+            name=f"ws-sub-{subscriber}")
         subs[query] = (subscriber, task)
         return {}
 

@@ -355,6 +355,25 @@ class HTTPClient:
         return resp["result"]
 
 
+async def relay_events(ws, get_msg, drain_timeout: float = 30.0) -> None:
+    """Pump `await get_msg()` results to a downstream websocket with
+    backpressure: a subscriber that stops reading must not buffer
+    event JSON in memory forever — it gets disconnected after
+    drain_timeout instead. Shared by the node's subscribe pump
+    (rpc/core.py) and the light proxy's passthrough."""
+    while True:
+        try:
+            msg = await get_msg()
+        except asyncio.CancelledError:
+            return
+        ws.send_json(msg)
+        try:
+            await asyncio.wait_for(ws.writer.drain(), drain_timeout)
+        except (asyncio.TimeoutError, ConnectionError):
+            ws.close()
+            return
+
+
 class WSClient:
     """Websocket JSON-RPC client with a notification queue
     (reference: rpc/jsonrpc/client/ws_client.go)."""

@@ -318,3 +318,50 @@ def test_proxy_verified_abci_query(tmp_path):
             await node.stop()
 
     run(go())
+
+
+def test_proxy_ws_subscription_passthrough(tmp_path):
+    """reference light/proxy/routes.go subscribe: WS subscriptions
+    relay the primary's event stream through the proxy."""
+    async def go():
+        import base64
+
+        from test_rpc import start_node
+
+        from tendermint_tpu.rpc.jsonrpc import WSClient
+
+        node = await start_node(tmp_path)
+        try:
+            await node.consensus_state.wait_for_height(2, timeout=60)
+            from tendermint_tpu.light.provider import RPCProvider
+
+            prov = RPCProvider("127.0.0.1", node.rpc_port)
+            trusted = await prov.light_block(1)
+            cl = Client(
+                "rpc-chain",
+                TrustOptions(period_ns=HOUR, height=1,
+                             hash=trusted.hash()),
+                prov, [prov], LightStore(MemDB()),
+                now_fn=lambda: trusted.time() + HOUR // 2,
+            )
+            await cl.initialize()
+            proxy = LightProxy(
+                cl, forward_client=HTTPClient("127.0.0.1",
+                                              node.rpc_port))
+            port = await proxy.listen("127.0.0.1", 0)
+            try:
+                ws = WSClient("127.0.0.1", port)
+                await ws.connect()
+                await ws.call("subscribe",
+                              query="tm.event = 'NewBlock'")
+                ev = await asyncio.wait_for(ws.events.get(), 30)
+                assert ev["result"]["data"]["type"] == "NewBlock"
+                await ws.call("unsubscribe",
+                              query="tm.event = 'NewBlock'")
+                ws.close()
+            finally:
+                proxy.close()
+        finally:
+            await node.stop()
+
+    run(go())
