@@ -23,7 +23,7 @@ from .errors import (
     LightClientError,
     NewValSetCantBeTrustedError,
 )
-from .provider import Provider
+from .provider import BlockNotFoundError, Provider, ProviderError
 from .store import LightStore
 from .types import LightBlock
 from .verifier import DEFAULT_TRUST_LEVEL, verify, verify_adjacent
@@ -74,7 +74,7 @@ class Client:
         if existing is not None:
             self._initialized = True
             return existing
-        lb = await self.primary.light_block(self.trust_options.height)
+        lb = await self._from_primary(self.trust_options.height)
         lb.validate_basic(self.chain_id)
         if lb.hash() != self.trust_options.hash:
             raise LightClientError(
@@ -105,10 +105,31 @@ class Client:
         assert latest_trusted is not None
         if height < latest_trusted.height():
             return await self._verify_backwards(height, now_ns)
-        target = await self.primary.light_block(height)
+        target = await self._from_primary(height)
         await self._verify_skipping(latest_trusted, target, now_ns)
         await self._detect_divergence(target, now_ns)
         return target
+
+    async def _from_primary(self, height: int) -> LightBlock:
+        """Fetch from the primary; on a TRANSPORT failure promote the
+        first witness to primary and retry (reference client.go:975
+        lightBlockFromPrimary + replacePrimaryProvider) — a dead or
+        unreachable primary must not strand the client while healthy
+        witnesses exist. BlockNotFoundError propagates unchanged: a
+        height that simply doesn't exist yet (the proxy's h+1 retry
+        window) is not grounds to burn a witness."""
+        while True:
+            try:
+                return await self.primary.light_block(height)
+            except BlockNotFoundError:
+                raise
+            except (ProviderError, OSError) as e:
+                if not self.witnesses:
+                    raise
+                old, self.primary = self.primary, self.witnesses.pop(0)
+                logger.warning(
+                    "primary %r failed (%s); promoting witness %r",
+                    old, e, self.primary)
 
     async def _verify_backwards(self, height: int,
                                 now_ns: int) -> LightBlock:
@@ -126,7 +147,7 @@ class Client:
             raise LightClientError(
                 f"anchor header {anchor_h} outside trusting period")
         while cur.height() > height:
-            interim = await self.primary.light_block(cur.height() - 1)
+            interim = await self._from_primary(cur.height() - 1)
             try:
                 interim.validate_basic(self.chain_id)
                 verify_backwards(interim.signed_header.header,
@@ -152,7 +173,7 @@ class Client:
         if not self._initialized:
             await self.initialize()
         now_ns = self.now_fn() if now_ns is None else now_ns
-        latest = await self.primary.light_block(0)
+        latest = await self._from_primary(0)
         trusted = self.store.latest()
         if trusted is not None and latest.height() <= trusted.height():
             return None
@@ -174,11 +195,16 @@ class Client:
         keep a stack of unverified blocks; verify what we can against
         the current trusted head, bisect when trust is insufficient.
 
-        `provider` supplies pivot blocks (default: the primary);
-        `persist=False` verifies without touching the trusted store —
-        used to examine a witness's conflicting header, which must
-        never pollute the store."""
-        provider = provider or self.primary
+        `provider` supplies pivot blocks (default: the primary WITH
+        failover — a primary dying mid-bisection must not strand the
+        client, reference verifySkipping routes pivots through
+        lightBlockFromPrimary); an EXPLICIT provider (divergence
+        examination of a specific witness) is used as-is and must not
+        trigger failover. `persist=False` verifies without touching
+        the trusted store — used to examine a witness's conflicting
+        header, which must never pollute the store."""
+        fetch = provider.light_block if provider is not None \
+            else self._from_primary
         pending: list[LightBlock] = [target]
         cache: dict[int, LightBlock] = {target.height(): target}
         steps = 0
@@ -196,7 +222,7 @@ class Client:
                 if pivot_h in (trusted.height(), block.height()) or \
                         pivot_h in cache:
                     raise  # can't split further: genuine failure
-                pivot = await provider.light_block(pivot_h)
+                pivot = await fetch(pivot_h)
                 cache[pivot_h] = pivot
                 pending.append(pivot)
                 continue
@@ -268,8 +294,6 @@ class Client:
         (caller drops it); "unreachable" when transport failures made
         examination impossible (caller keeps the witness — a network
         blip must not be classified as an unprovable forgery)."""
-        from .provider import ProviderError
-
         witness = self.witnesses[div.witness_index]
         target_h = div.primary_block.height()
         common, reachable = await self._find_common_block(witness, target_h)

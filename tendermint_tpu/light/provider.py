@@ -76,7 +76,18 @@ class RPCProvider(Provider):
                 page += 1
             vals = validator_set_from_json(vals_pages)
         except RPCError as e:
-            raise BlockNotFoundError(str(e)) from e
+            # Only height-not-there responses are "not found" (the
+            # normal not-committed-yet signal, which must NOT trigger
+            # primary failover); any other JSON-RPC error — internal
+            # errors, broken handlers — is a provider failure.
+            msg = str(e)
+            if "not available" in msg or "not found" in msg:
+                raise BlockNotFoundError(msg) from e
+            raise ProviderError(msg) from e
+        except (ValueError, KeyError, TypeError) as e:
+            # malformed/truncated responses (HTML 502 pages, bad JSON,
+            # missing fields) are transport-class provider failures
+            raise ProviderError(f"malformed response: {e}") from e
         return LightBlock(SignedHeader(header, commit), vals)
 
     async def report_evidence(self, ev) -> None:

@@ -264,3 +264,39 @@ def test_backwards_rejects_tampering_primary():
     with pytest.raises(LightClientError, match="backwards"):
         run(cl.verify_light_block_at_height(3))
     assert cl.store.get(5) is None and cl.store.get(3) is None
+
+
+def test_dead_primary_promotes_witness():
+    """reference client.go:975 lightBlockFromPrimary /
+    replacePrimaryProvider: a primary failing with a transport error
+    is replaced by the first witness and verification proceeds;
+    BlockNotFoundError does NOT burn a witness (it is the normal
+    height-not-committed-yet signal)."""
+    from tendermint_tpu.light.provider import (
+        BlockNotFoundError, Provider, ProviderError)
+
+    chain = LightChain(8)
+
+    class DeadPrimary(Provider):
+        async def light_block(self, height):
+            raise ProviderError("connection refused")
+
+        def __repr__(self):
+            return "DeadPrimary"
+
+    good = chain.provider()
+    cl = _client(chain, primary=DeadPrimary(), witnesses=[good])
+    lb = run(cl.verify_light_block_at_height(5))
+    assert lb.height() == 5
+    assert cl.primary is good and cl.witnesses == []
+
+    # not-found propagates without provider churn
+    cl2 = _client(chain, witnesses=[chain.provider()])
+    with pytest.raises(BlockNotFoundError):
+        run(cl2.verify_light_block_at_height(999))
+    assert len(cl2.witnesses) == 1
+
+    # all providers dead -> the transport error surfaces
+    cl3 = _client(chain, primary=DeadPrimary(), witnesses=[DeadPrimary()])
+    with pytest.raises(ProviderError):
+        run(cl3.verify_light_block_at_height(5))
