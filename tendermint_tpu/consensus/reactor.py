@@ -153,6 +153,13 @@ class PeerState:
             return
         if self.round != msg.round and not msg.is_commit:
             return
+        # REPLACE, not OR: the sender's advert is its true holdings,
+        # and our marks include optimistic send-time marks that may be
+        # wrong (parts sent against a header it since replaced). An OR
+        # would preserve exactly the stale marks the periodic
+        # commit-advert exists to heal; the cost — re-sending a few
+        # in-flight parts after each advert — is bounded and ends at
+        # block completion.
         self.proposal_block_parts_header = msg.block_parts_header
         self.proposal_block_parts = msg.block_parts
 
@@ -222,6 +229,15 @@ class PeerState:
     def __repr__(self) -> str:
         return (f"PeerState({self.peer.id[:8]} h={self.height} "
                 f"r={self.round} s={self.step.name})")
+
+
+def _new_valid_block_msg(rs: RoundState, parts,
+                         is_commit: bool) -> m.NewValidBlockMessage:
+    return m.NewValidBlockMessage(
+        height=rs.height, round=rs.round,
+        block_parts_header=parts.header(),
+        block_parts=parts.parts_bitarray,
+        is_commit=is_commit)
 
 
 def _new_round_step_msg(rs: RoundState) -> m.NewRoundStepMessage:
@@ -438,20 +454,16 @@ class ConsensusReactor(Reactor):
                     rs.valid_block_parts is not None:
                 self.switch.broadcast(
                     STATE_CHANNEL,
-                    m.encode_consensus_msg(m.NewValidBlockMessage(
-                        height=rs.height, round=rs.round,
-                        block_parts_header=rs.valid_block_parts.header(),
-                        block_parts=rs.valid_block_parts.parts_bitarray,
+                    m.encode_consensus_msg(_new_valid_block_msg(
+                        rs, rs.valid_block_parts,
                         is_commit=rs.step == RoundStep.COMMIT)))
         elif event == "valid_block":
             rs = payload
             if rs.proposal_block_parts is not None:
                 self.switch.broadcast(
                     STATE_CHANNEL,
-                    m.encode_consensus_msg(m.NewValidBlockMessage(
-                        height=rs.height, round=rs.round,
-                        block_parts_header=rs.proposal_block_parts.header(),
-                        block_parts=rs.proposal_block_parts.parts_bitarray,
+                    m.encode_consensus_msg(_new_valid_block_msg(
+                        rs, rs.proposal_block_parts,
                         is_commit=rs.step == RoundStep.COMMIT)))
         elif event == "has_vote":
             self.switch.broadcast(STATE_CHANNEL,
@@ -490,9 +502,28 @@ class ConsensusReactor(Reactor):
     async def _gossip_data_routine(self, ps: PeerState) -> None:
         """reference: gossipDataRoutine (reactor.go:492)."""
         peer = ps.peer
+        last_advert = 0.0
         try:
             while True:
                 rs = self.cs.rs
+                # 0) WE are stuck in COMMIT missing the decided block:
+                # remind this peer which part set we accept. The
+                # one-shot valid_block broadcast from _enter_commit is
+                # best-effort (peers may not even be connected yet at
+                # net start), and peers gate their catch-up gossip on
+                # having seen it — a lost advert wedged a node at its
+                # commit height FOREVER while the net raced ahead
+                # (found by the 120-run double-propose stress).
+                if rs.step == RoundStep.COMMIT and \
+                        rs.proposal_block is None and \
+                        rs.proposal_block_parts is not None and \
+                        time.monotonic() - last_advert > 1.0:
+                    last_advert = time.monotonic()
+                    await peer.send(
+                        STATE_CHANNEL,
+                        m.encode_consensus_msg(_new_valid_block_msg(
+                            rs, rs.proposal_block_parts,
+                            is_commit=True)))
                 # 1) send a proposal block part the peer lacks
                 if rs.height == ps.height and rs.round == ps.round and \
                         rs.proposal_block_parts is not None and \
