@@ -900,14 +900,21 @@ class ConsensusState(Service):
                             "dropping unprocessable vote from %r", peer_id)
                 # Same trust feedback as the happy path: a peer
                 # streaming invalid votes must not farm free host
-                # crypto just because the device is down.
+                # crypto just because the device is down. Guarded per
+                # peer like the happy path — an exception escaping
+                # this except-handler would kill the scheduler task,
+                # the silent-halt mode this fallback exists to prevent.
                 rep = self.reporter_fn()
                 if rep is not None:
                     for peer_id, (good, bad) in per_peer.items():
-                        rep.observe(peer_id, good=good, bad=bad)
-                        if bad:
-                            await rep.enforce(peer_id,
-                                              "invalid vote signature")
+                        try:
+                            rep.observe(peer_id, good=good, bad=bad)
+                            if bad:
+                                await rep.enforce(
+                                    peer_id, "invalid vote signature")
+                        except Exception:
+                            self.logger.exception(
+                                "trust feedback failed for %r", peer_id)
 
     async def _verify_and_commit_batch(self, batch, met, loop) -> None:
         met.vote_batch_size.observe(len(batch))

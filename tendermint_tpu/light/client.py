@@ -64,6 +64,14 @@ class Client:
         self.trust_level = trust_level
         self.now_fn = now_fn
         self._initialized = False
+        # In-memory linkage-verified headers from backwards walks
+        # (NOT the trusted store — their commits are unverified).
+        # Bounds request amplification: without it, every old-height
+        # query re-walks the hash chain from the trusted head — one
+        # cheap blockchain(1..20) RPC against a deep chain meant
+        # ~depth x 20 sequential primary fetches.
+        self._interim_cache: dict[int, LightBlock] = {}
+        self._interim_cache_max = 4096
 
     # -- bootstrap --
 
@@ -118,17 +126,28 @@ class Client:
         witnesses exist. BlockNotFoundError propagates unchanged: a
         height that simply doesn't exist yet (the proxy's h+1 retry
         window) is not grounds to burn a witness."""
+        tries = 0
         while True:
             try:
                 return await self.primary.light_block(height)
             except BlockNotFoundError:
                 raise
             except (ProviderError, OSError) as e:
-                if not self.witnesses:
+                tries += 1
+                if not self.witnesses or tries > len(self.witnesses) + 1:
                     raise
+                # ROTATE, don't consume: the failed primary goes to
+                # the END of the witness list instead of being
+                # discarded — transient blips must not permanently
+                # shrink the witness set until fork detection is
+                # silently disabled (the divergence check already
+                # tolerates unreachable witnesses). The tries bound
+                # stops an all-dead provider set from cycling forever.
                 old, self.primary = self.primary, self.witnesses.pop(0)
+                self.witnesses.append(old)
                 logger.warning(
-                    "primary %r failed (%s); promoting witness %r",
+                    "primary %r failed (%s); promoting witness %r "
+                    "(failed primary demoted to witness)",
                     old, e, self.primary)
 
     async def _verify_backwards(self, height: int,
@@ -141,12 +160,24 @@ class Client:
         trusting period."""
         from .verifier import verify_backwards
 
-        anchor_h = min(h for h in self.store.heights() if h > height)
-        cur = self.store.get(anchor_h)
+        # Nearest anchor above the target: a trusted block, or a
+        # cached interim from an earlier walk (sound — its hash chain
+        # was verified down from a trusted anchor; the period check
+        # below is applied to whichever anchor we start from, which
+        # for an interim is STRICTER, its time being older).
+        anchor_h = min(h for h in (set(self.store.heights()) |
+                                   set(self._interim_cache))
+                       if h > height)
+        cur = self.store.get(anchor_h) or self._interim_cache[anchor_h]
         if cur.time() + self.trust_options.period_ns <= now_ns:
             raise LightClientError(
                 f"anchor header {anchor_h} outside trusting period")
         while cur.height() > height:
+            cached = self._interim_cache.get(cur.height() - 1)
+            if cached is not None and cached.hash() == \
+                    cur.signed_header.header.last_block_id.hash:
+                cur = cached
+                continue
             interim = await self._from_primary(cur.height() - 1)
             try:
                 interim.validate_basic(self.chain_id)
@@ -156,13 +187,16 @@ class Client:
                 raise LightClientError(
                     f"backwards verification failed at height "
                     f"{cur.height() - 1}: {e}") from e
-            # Interim blocks are NOT persisted (reference client.go:
-            # "Intermediate headers are not saved to database"): the
-            # hash-chain walk proves linkage only — the interim
-            # commits' signatures were never verified, and a stored
-            # block would later read as fully trusted (served to
-            # peers, used as a divergence anchor). Only the requested
-            # target is saved, below.
+            # Interim blocks are NOT persisted to the TRUSTED store
+            # (reference client.go: "Intermediate headers are not
+            # saved to database"): the hash-chain walk proves linkage
+            # only — the interim commits' signatures were never
+            # verified, and a stored block would later read as fully
+            # trusted. They do go into the bounded in-memory linkage
+            # cache so repeated old-height walks don't re-fetch the
+            # whole chain. Only the requested target is saved, below.
+            if len(self._interim_cache) < self._interim_cache_max:
+                self._interim_cache[interim.height()] = interim
             cur = interim
         self.store.save(cur)
         return cur

@@ -285,10 +285,13 @@ def test_dead_primary_promotes_witness():
             return "DeadPrimary"
 
     good = chain.provider()
-    cl = _client(chain, primary=DeadPrimary(), witnesses=[good])
+    dead = DeadPrimary()
+    cl = _client(chain, primary=dead, witnesses=[good])
     lb = run(cl.verify_light_block_at_height(5))
     assert lb.height() == 5
-    assert cl.primary is good and cl.witnesses == []
+    # ROTATED, not consumed: the dead primary is demoted to the
+    # witness list (transient blips must not shrink the witness set)
+    assert cl.primary is good and cl.witnesses == [dead]
 
     # not-found propagates without provider churn
     cl2 = _client(chain, witnesses=[chain.provider()])

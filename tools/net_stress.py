@@ -1,0 +1,110 @@
+"""Liveness wedge hunter: run many short in-process 4-validator nets
+(optionally with a maverick misbehavior) and fail loudly on any net
+that stalls — full round-state dump included.
+
+This is the harness that found the round-4 lost-advert wedge (a node
+stuck in COMMIT forever because its one-shot NewValidBlock broadcast
+was lost): the per-run cost is ~1.5 s, so hundreds of independent
+net startups — where the rare interleavings live — fit in minutes,
+unlike the e2e subprocess runner.
+
+    python tools/net_stress.py [--runs 100] [--misbehavior double-propose]
+                               [--target-height 4] [--stall 25]
+"""
+
+import asyncio
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/tm_tpu_jax_cache")
+
+
+def _dump(nodes) -> None:
+    for j, n in enumerate(nodes):
+        rs = n.cs.rs
+        print(f"  node{j}: h={rs.height} r={rs.round} step={rs.step} "
+              f"locked_r={rs.locked_round} valid_r={rs.valid_round} "
+              f"proposal={'Y' if rs.proposal else 'N'} "
+              f"pblock={'Y' if rs.proposal_block else 'N'} "
+              f"parts={'Y' if rs.proposal_block_parts else 'N'}",
+              flush=True)
+        if rs.votes is not None:
+            for r in range(max(0, rs.round - 1), rs.round + 1):
+                pv = rs.votes.prevotes(r)
+                pc = rs.votes.precommits(r)
+                print(f"    r{r}: prevotes="
+                      f"{pv.sum if pv else '-'} "
+                      f"precommits={pc.sum if pc else '-'}", flush=True)
+
+
+async def one(i: int, misbehavior: str, target_h: int,
+              stall_s: float) -> bool:
+    from p2p_harness import make_net
+
+    from tendermint_tpu.consensus.misbehavior import MISBEHAVIORS
+
+    nodes = await make_net(4)
+    try:
+        if misbehavior:
+            for n in nodes:
+                n.cs.misbehaviors[2] = MISBEHAVIORS[misbehavior]()
+        deadline = time.monotonic() + max(60.0, stall_s * 3)
+        last_view, last_change = None, time.monotonic()
+        while True:
+            view = tuple((n.cs.rs.height, n.cs.rs.round,
+                          int(n.cs.rs.step)) for n in nodes)
+            if all(h >= target_h for h, _, _ in view):
+                return True
+            now = time.monotonic()
+            if view != last_view:
+                last_view, last_change = view, now
+            if now - last_change > stall_s or now > deadline:
+                print(f"RUN {i} WEDGED: view={view}", flush=True)
+                _dump(nodes)
+                return False
+            await asyncio.sleep(0.1)
+    finally:
+        for n in nodes:
+            try:
+                await n.stop()
+            except Exception:
+                pass
+
+
+async def main() -> int:
+    runs, mis, target_h, stall = 100, "", 4, 25.0
+    args = sys.argv
+    for i, a in enumerate(args):
+        if a == "--runs":
+            runs = int(args[i + 1])
+        elif a == "--misbehavior":
+            mis = args[i + 1]
+        elif a == "--target-height":
+            target_h = int(args[i + 1])
+        elif a == "--stall":
+            stall = float(args[i + 1])
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    wedges = 0
+    t0 = time.monotonic()
+    for i in range(runs):
+        if not await one(i, mis, target_h, stall):
+            wedges += 1
+        if (i + 1) % 25 == 0:
+            print(f"progress: {i + 1}/{runs}, {wedges} wedges, "
+                  f"{time.monotonic() - t0:.0f}s", flush=True)
+    label = mis or "clean"
+    print(f"net_stress [{label}]: {wedges} wedges / {runs} runs")
+    return 1 if wedges else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(asyncio.run(main()))
