@@ -199,7 +199,22 @@ class Runner:
         # a snapshot is taken at height 4 (interval 4); the light
         # provider probes trust..snapshot+2
         await self.wait_net_height(7)
-        commit = await self._rpc(self.nodes[0], "commit", height=2)
+        # Fetch the trust root from ANY live node, with retries: a
+        # perturbation may have just killed/restarted the first one
+        # (found by the combined statesync+perturbation scenario).
+        commit = None
+        for attempt in range(20):
+            for node in self.nodes[:-1]:
+                try:
+                    commit = await self._rpc(node, "commit", height=2)
+                    break
+                except Exception:
+                    continue
+            if commit is not None:
+                break
+            await asyncio.sleep(1.0)
+        if commit is None:
+            raise RuntimeError("no live node to fetch the trust root")
         trust_hash = commit["signed_header"]["commit"]["block_id"]["hash"]
         cfg_path = os.path.join(late.home, "config", "config.toml")
         cfg = Config.load(cfg_path)

@@ -160,15 +160,14 @@ class Client:
         trusting period."""
         from .verifier import verify_backwards
 
-        # Nearest anchor above the target: a trusted block, or a
-        # cached interim from an earlier walk (sound — its hash chain
-        # was verified down from a trusted anchor; the period check
-        # below is applied to whichever anchor we start from, which
-        # for an interim is STRICTER, its time being older).
-        anchor_h = min(h for h in (set(self.store.heights()) |
-                                   set(self._interim_cache))
-                       if h > height)
-        cur = self.store.get(anchor_h) or self._interim_cache[anchor_h]
+        # Anchor on the nearest TRUSTED block — the trusting-period
+        # check applies to it, never to a cached interim (an interim's
+        # older timestamp could fail the check while a perfectly valid
+        # trusted anchor exists above). The walk loop below consults
+        # the linkage cache per step, so a cached chain still costs
+        # zero fetches.
+        anchor_h = min(h for h in self.store.heights() if h > height)
+        cur = self.store.get(anchor_h)
         if cur.time() + self.trust_options.period_ns <= now_ns:
             raise LightClientError(
                 f"anchor header {anchor_h} outside trusting period")
@@ -195,8 +194,10 @@ class Client:
             # trusted. They do go into the bounded in-memory linkage
             # cache so repeated old-height walks don't re-fetch the
             # whole chain. Only the requested target is saved, below.
-            if len(self._interim_cache) < self._interim_cache_max:
-                self._interim_cache[interim.height()] = interim
+            if len(self._interim_cache) >= self._interim_cache_max:
+                # evict oldest-inserted so cold ranges still cache
+                self._interim_cache.pop(next(iter(self._interim_cache)))
+            self._interim_cache[interim.height()] = interim
             cur = interim
         self.store.save(cur)
         return cur

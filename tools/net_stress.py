@@ -53,7 +53,16 @@ async def one(i: int, misbehavior: str, target_h: int,
     nodes = await make_net(4)
     try:
         if misbehavior:
-            for n in nodes:
+            # Stay inside the f=1 byzantine bound: PROPOSER-triggered
+            # misbehaviors (double-propose) fire only on the height-2
+            # proposer, so installing on every node still yields
+            # exactly ONE equivocator per run (and makes the scenario
+            # deterministic); VOTER-triggered ones (double-prevote)
+            # fire on every installed node, so they go on a single
+            # maverick — four equivocating voters would exceed f=1 and
+            # any stall would be protocol-legal, not a bug.
+            targets = nodes if "propose" in misbehavior else [nodes[3]]
+            for n in targets:
                 n.cs.misbehaviors[2] = MISBEHAVIORS[misbehavior]()
         deadline = time.monotonic() + max(60.0, stall_s * 3)
         last_view, last_change = None, time.monotonic()
