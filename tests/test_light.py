@@ -303,3 +303,44 @@ def test_dead_primary_promotes_witness():
     cl3 = _client(chain, primary=DeadPrimary(), witnesses=[DeadPrimary()])
     with pytest.raises(ProviderError):
         run(cl3.verify_light_block_at_height(5))
+
+
+def test_backwards_cache_and_trusted_anchor():
+    """The backwards-walk linkage cache serves repeat walks without
+    refetching, and anchor selection stays on TRUSTED blocks: a
+    cached interim with an older timestamp must not fail the
+    trusting-period check while a valid trusted anchor exists."""
+    chain = LightChain(30)
+    fetches = []
+
+    base = chain.provider()
+
+    class Counting(Provider):
+        async def light_block(self, height):
+            fetches.append(height)
+            return await base.light_block(height)
+
+    cl = _client(chain, trust_height=1, primary=Counting())
+    run(cl.verify_light_block_at_height(30))  # trusted head at 30
+    run(cl.verify_light_block_at_height(10))  # walks 29..10
+    n_first = len(fetches)
+    assert n_first >= 19, f"first walk should fetch ~20 blocks, got {n_first}"
+    fetches.clear()
+    # second old-height walk in the cached range: zero new fetches
+    lb = run(cl.verify_light_block_at_height(20))
+    assert lb.height() == 20
+    assert fetches == [], f"cached walk refetched {fetches}"
+    # anchor selection ignores cache entries: a cached interim with
+    # an older header time sits closest above the target, the trust
+    # period covers only the head — the walk must anchor on the
+    # trusted head (and may still USE the cached link), not fail the
+    # period check on the interim
+    cl2 = _client(chain, trust_height=1, primary=Counting())
+    run(cl2.verify_light_block_at_height(30))
+    cl2._interim_cache[29] = chain.blocks[29]
+    # period covers h30 (time T0+30, now T0+100) but not h29
+    cl2.trust_options.period_ns = 70 * 1_000_000_000 + 500_000_000
+    fetches.clear()
+    lb = run(cl2.verify_light_block_at_height(15))
+    assert lb.height() == 15
+    assert 29 not in fetches, "cached link for h29 was refetched"
