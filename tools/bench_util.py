@@ -1,0 +1,48 @@
+"""Shared pipelined-launch device-time estimator.
+
+Used by bench.py and tools/profile_tpu.py so the two tools' "device
+ms/launch" numbers come from the same protocol.
+
+The problem it solves: a synced single launch through the axon relay
+measures RTT + dispatch + device execution, and RTT dominates at small
+batches. Dispatching k async launches back-to-back pipelines them on
+device behind ONE sync, so the difference between two burst sizes
+isolates pure device execution:
+
+    per_launch = (T(k_big) - T(k_small)) / (k_big - k_small)
+
+Both bursts amortize exactly one round-trip, so the RTT term cancels
+in the subtraction (a single-sample "burst minus single" estimate can
+go negative under relay jitter; the two-burst slope is robust to it).
+"""
+
+import time
+
+
+def pipelined_exec_s(dispatch, k_small=4, k_big=12):
+    """Estimate per-launch device execution time for `dispatch`.
+
+    dispatch: zero-arg callable that async-dispatches one launch on
+    device-resident inputs and returns a JAX array (block_until_ready
+    must be valid on it).
+
+    Returns (per_launch_s | None, single_synced_s, {k: burst_total_s}).
+    per_launch_s is None when the slope came out non-positive (relay
+    jitter exceeded the device work — report it as unmeasurable, not
+    as a garbage number).
+    """
+    dispatch().block_until_ready()  # warm compile/arg-kind + drain queue
+
+    t0 = time.perf_counter()
+    dispatch().block_until_ready()
+    single = time.perf_counter() - t0
+
+    def burst(k):
+        t0 = time.perf_counter()
+        outs = [dispatch() for _ in range(k)]
+        outs[-1].block_until_ready()
+        return time.perf_counter() - t0
+
+    totals = {k_small: burst(k_small), k_big: burst(k_big)}
+    per = (totals[k_big] - totals[k_small]) / (k_big - k_small)
+    return (per if per > 0 else None), single, totals

@@ -1,0 +1,96 @@
+#!/bin/bash
+# Round-4 silicon measurement loop. Probes the axon relay cheaply; when
+# the chip answers, runs the measurement sequence. Each step is guarded
+# by a marker file so a retry after a relay wedge goes straight to the
+# incomplete steps (in particular: a failed bench is retried WITHOUT
+# first re-paying the profile runs). Exits after the headline bench
+# succeeds non-provisionally; every jit lands in the persistent
+# compilation cache so the driver's end-of-round bench run is fast even
+# if the relay flakes again.
+set -u
+OUT=${OUT:-/tmp/r4}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+export JAX_COMPILATION_CACHE_DIR=/tmp/tm_tpu_jax_cache
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
+
+log() { echo "[$(date -u +%H:%M:%S)] $*" >> "$OUT/measure.log"; }
+
+probe() {
+    timeout 150 python - <<'EOF' >/dev/null 2>&1
+import jax
+assert any("TPU" in str(d) or "tpu" in str(d).lower() for d in jax.devices())
+EOF
+}
+
+# Headline success = last JSON line has a numeric value and is neither
+# the provisional stage-1 projection nor the CPU fallback.
+bench_ok() {
+    python - "$OUT/bench.out" <<'EOF' >/dev/null 2>&1
+import json, sys
+last = None
+for ln in open(sys.argv[1], errors="replace"):
+    ln = ln.strip()
+    if ln.startswith("{") and ln.endswith("}"):
+        try:
+            last = json.loads(ln)
+        except ValueError:
+            pass
+assert last and isinstance(last.get("value"), (int, float))
+assert not last.get("provisional") and not last.get("cpu_fallback")
+EOF
+}
+
+step() {  # step NAME TIMEOUT CMD... — run once, marker-guarded
+    local name=$1 tmo=$2; shift 2
+    [ -e "$OUT/done.$name" ] && return 0
+    timeout "$tmo" "$@" > "$OUT/$name.out" 2>&1
+    local rc=$?
+    log "$name rc=$rc"
+    [ $rc -eq 0 ] && touch "$OUT/done.$name"
+    return $rc
+}
+
+log "watcher started"
+while true; do
+    if ! probe; then
+        log "probe failed; sleeping 180s"
+        sleep 180
+        continue
+    fi
+    log "probe OK - chip is up"
+    # Any step failure = relay likely wedged: go back to the cheap
+    # probe loop instead of burning the next step's timeout on a dead
+    # relay. Markers make the retry resume at the incomplete step.
+    # 1. Stage-by-stage profile at 1k: where do the milliseconds go?
+    step prof_1024 900 python tools/profile_tpu.py 1024 1024 \
+        || { sleep 60; continue; }
+    # 2. Full-size profile (table build at 10,240 keys is the suspect
+    #    for the killed 410s bench worker) — also warms the caches the
+    #    bench and the driver's end-of-round run need.
+    step prof_10240 1500 python tools/profile_tpu.py 10240 10240 \
+        || { sleep 60; continue; }
+    # 3. Headline bench with headroom; compiles now cached. Retried on
+    #    every loop iteration until non-provisional (no marker).
+    TM_TPU_BENCH_DEADLINE_S=900 timeout 950 python bench.py \
+        > "$OUT/bench.out" 2>&1
+    log "bench rc=$?"
+    if ! bench_ok; then
+        log "bench not (yet) non-provisional; will retry after probe"
+        sleep 60
+        continue
+    fi
+    log "headline bench landed"
+    # 4. A/B the window-loop unroll factor (the 69-iteration fori_loop
+    #    is the latency suspect; knob never timed on silicon).
+    TM_TPU_WINDOWS_PER_ITER=3 step prof_wpi3 600 \
+        python tools/profile_tpu.py 1024 1024 || { sleep 60; continue; }
+    TM_TPU_WINDOWS_PER_ITER=23 step prof_wpi23 600 \
+        python tools/profile_tpu.py 1024 1024 || { sleep 60; continue; }
+    # 5. Threshold sweep (bounded sizes to keep it inside a window).
+    step sweep 1200 python tools/sweep_thresholds.py \
+        --sizes 16,32,64,128,256,512,1024,2048 --sr-sizes 16,64,256 \
+        --out "$OUT/THRESHOLDS.md" || { sleep 60; continue; }
+    log "sequence complete - exiting"
+    exit 0
+done

@@ -79,6 +79,30 @@ def main():
         o.block_until_ready()
         log(f"device launch #{i} {1e3 * (time.perf_counter() - t):.1f}ms")
 
+    # Separate per-launch DEVICE time from the (relay/tunnel) round-trip
+    # and per-call input transfer in the synced numbers above: shared
+    # two-burst slope estimator (same protocol bench.py reports).
+    from tools.bench_util import pipelined_exec_s
+
+    dpidx = jax.device_put(pidx)
+    dpacked = {k: jax.device_put(v) for k, v in packed.items()}
+    per, single, totals = pipelined_exec_s(
+        lambda: exp._launch(dpidx, dpacked))
+    for k, tt in totals.items():
+        log(f"pipelined x{k} (device-resident inputs): total "
+            f"{1e3 * tt:.1f}ms")
+    log(f"single synced launch {1e3 * single:.1f}ms; device exec "
+        f"{'unmeasurable (relay jitter)' if per is None else f'{1e3 * per:.2f}ms'}/launch")
+    # Same launches from host numpy inputs: includes per-call
+    # host->device transfer (the production cold-call shape).
+    for k in (1, 4):
+        t = time.perf_counter()
+        outs = [exp._launch(pidx, packed) for _ in range(k)]
+        outs[-1].block_until_ready()
+        dt = 1e3 * (time.perf_counter() - t)
+        log(f"pipelined x{k} (host inputs): total {dt:.1f}ms "
+            f"({dt / k:.1f}ms/launch)")
+
 
 if __name__ == "__main__":
     main()
