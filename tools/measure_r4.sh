@@ -91,6 +91,10 @@ while true; do
     step sweep 1200 python tools/sweep_thresholds.py \
         --sizes 16,32,64,128,256,512,1024,2048 --sr-sizes 16,64,256 \
         --out "$OUT/THRESHOLDS.md" || { sleep 60; continue; }
+    # 6. Crypto micro-bench table (keygen/sign/verify per key type,
+    #    host + device paths — BASELINE config #4's sr25519 numbers).
+    step crypto_bench 900 python tools/crypto_bench.py \
+        || { sleep 60; continue; }
     log "sequence complete - exiting"
     exit 0
 done
