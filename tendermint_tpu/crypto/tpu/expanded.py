@@ -120,8 +120,11 @@ def _xkernel(wpi: int = WINDOWS_PER_ITER):
     assert _WINDOWS % wpi == 0, "windows-per-iter must divide 69"
 
     @jax.jit
-    def kernel(idx, ab, sb, msg, nblocks, s_ok, key_ok, atab, btab):
+    def kernel(idx, akeys, sb, msg, nblocks, s_ok, key_ok, atab, btab):
         n = idx.shape[0]
+        # Pubkey bytes gathered from the device-resident key array —
+        # the host sends (N,) indices, not (N, 32) pubkey rows.
+        ab = jnp.take(akeys, idx, axis=0)
         # SHA-512(R || A || M) + fold, exactly as the general kernel.
         full = jnp.concatenate([sb[:, :32], ab, msg], axis=1)
         digest = sh.compress_blocks(sh.bytes_to_words(full), nblocks)
@@ -215,7 +218,6 @@ class ExpandedKeys:
         self.pubkeys = tuple(bytes(p) for p in pubkeys)
         assert all(len(p) == 32 for p in self.pubkeys)
         a_raw = np.frombuffer(b"".join(self.pubkeys), np.uint8).reshape(-1, 32)
-        self._a_raw = a_raw
         v = len(self.pubkeys)
         if v <= self.BUILD_CHUNK:
             tables, ok = _builder()(jnp.asarray(a_raw))
@@ -247,6 +249,7 @@ class ExpandedKeys:
         # chip-local at 69 * 512 B/lane. HBM cost is the table size per
         # chip (~318 KB/key, 3.3 GB at 10k keys — within a v5e's 16 GB;
         # beyond ~40k keys switch to key-range sharding + lane routing).
+        akeys = jnp.asarray(a_raw)
         mesh = tv._mesh()
         if mesh is not None:
             import jax
@@ -254,8 +257,12 @@ class ExpandedKeys:
             _, _, repl_s = tv._shardings(mesh)
             tables = jax.device_put(tables, repl_s)
             ok = jax.device_put(ok, repl_s)
+            akeys = jax.device_put(akeys, repl_s)
         self.tables = tables  # keep on device
         self.key_ok = ok
+        # Pubkey bytes device-resident beside the tables: verify
+        # launches send (N,) indices instead of (N, 32) pubkey rows.
+        self.akeys = akeys
 
     def __len__(self) -> int:
         return len(self.pubkeys)
@@ -300,9 +307,8 @@ class ExpandedKeys:
             msgs = list(msgs) + [b""] * pad
             joined += b"\0" * (64 * pad)
 
-        a_raw = self._a_raw[idx]
         sig_raw = np.frombuffer(joined, np.uint8).reshape(bucket, 64)
-        packed = tv.pack_arrays(a_raw, sig_raw, msgs)
+        packed = tv.pack_sig_msg(sig_raw, msgs)
         return idx, packed, well_formed
 
     def _launch(self, idx, packed):
@@ -326,6 +332,7 @@ class ExpandedKeys:
             btab = jax.device_put(btab, repl_s)
         return _xkernel(WINDOWS_PER_ITER)(
             idx=idx,
+            akeys=self.akeys,
             key_ok=self.key_ok,
             atab=self.tables,
             btab=btab,

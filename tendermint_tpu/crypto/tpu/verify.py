@@ -114,11 +114,20 @@ def pack_batch(pubs, msgs, sigs) -> dict[str, np.ndarray]:
 
 
 def pack_arrays(a_raw: np.ndarray, sig_raw: np.ndarray, msgs) -> dict[str, np.ndarray]:
-    """pack_batch core on pre-built (N, 32)/(N, 64) uint8 arrays (shared
-    with the expanded-valset path, which gathers pubkey rows by index)."""
+    """pack_batch core on pre-built (N, 32)/(N, 64) uint8 arrays."""
+    return dict(pack_sig_msg(sig_raw, msgs), ab=a_raw)
+
+
+def pack_sig_msg(sig_raw: np.ndarray, msgs) -> dict[str, np.ndarray]:
+    """Signature/message half of the pack — everything except the
+    pubkey rows. The expanded-valset path sends only this plus the
+    (N,) key indices per launch: its pubkey bytes are already
+    device-resident next to the comb tables, so shipping (N, 32)
+    pubkey rows per call would be pure wasted host->device transfer
+    (32 B/lane — ~330 KB per 10,240-lane commit through the relay)."""
     from . import sha512 as sh
 
-    n = a_raw.shape[0]
+    n = sig_raw.shape[0]
     msg_pad, nblocks = sh.pad_messages(list(msgs), prefix_len=64)
     # Bucket the padded width to power-of-two block counts so kernel
     # shapes (and recompiles) stay bounded; extra blocks are zeros and
@@ -137,7 +146,6 @@ def pack_arrays(a_raw: np.ndarray, sig_raw: np.ndarray, msgs) -> dict[str, np.nd
         lt |= ~gt & ~lt & (s_words[:, w] < _L_WORDS[w])
         gt |= ~gt & ~lt & (s_words[:, w] > _L_WORDS[w])
     return dict(
-        ab=a_raw,
         sb=sig_raw,
         msg=msg_pad,
         nblocks=nblocks,

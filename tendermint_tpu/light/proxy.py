@@ -406,8 +406,13 @@ class LightProxy:
         here instead of reaching the caller."""
         import base64
 
+        from ..rpc.core import hexbytes_param
+
+        # Decode once (hex / 0x-hex / URI-quoted raw) and forward as
+        # plain hex so the primary sees one canonical form.
+        want = hexbytes_param(data)
         res = await self._forwarder("abci_query")(
-            ctx, path=path, data=data, height=height, prove=True)
+            ctx, path=path, data=want.hex(), height=height, prove=True)
         resp = res.get("response", {})
         if int(resp.get("code", 0)) != 0:
             raise RPCError(-32603,
@@ -418,10 +423,6 @@ class LightProxy:
         # The proof must be about the key WE asked for — a primary
         # that answers with a different key (and a perfectly valid
         # proof for it) must not pass.
-        from ..rpc.core import coerce_hex_param
-
-        data = coerce_hex_param(data)
-        want = bytes.fromhex(data) if data else b""
         if key != want:
             raise RPCError(
                 -32603,

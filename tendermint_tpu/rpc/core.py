@@ -16,9 +16,36 @@ from ..libs.pubsub import Query
 from ..types.events import (
     EventDataNewBlock, EventDataTx, query_for_event,
 )
-from .jsonrpc import RPCError
+from .jsonrpc import RawStr, RPCError, UriStr
 
 _SUBSCRIBER_PREFIX = "ws-"
+
+
+def _tx_bytes(v) -> bytes:
+    """Byte-typed RPC param from either transport (reference: the URI
+    handler decodes quoted values as raw content and 0x-values as hex,
+    while JSON-RPC carries []byte base64-encoded). RawStr marks a
+    URI-quoted value; `curl '...?tx="k=v"'` is the documented usage."""
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, RawStr):
+        return v.encode()
+    if isinstance(v, UriStr) and v.startswith("0x"):
+        # URI-only: a JSON-RPC base64 payload that happens to look
+        # like 0x-hex must not be hex-decoded. Malformed hex is an
+        # error, not a base64 fallback (a typo'd hex tx that survives
+        # base64 decoding would broadcast garbage bytes).
+        try:
+            return bytes.fromhex(v[2:])
+        except ValueError as e:
+            raise RPCError(-32602, "invalid 0x-hex byte param") from e
+    try:
+        return base64.b64decode(v, validate=True)
+    except Exception as e:
+        raise RPCError(
+            -32602,
+            "invalid byte param: expected base64 (JSON-RPC), a "
+            '"quoted" raw string, or 0x-hex (URI)') from e
 
 
 def coerce_hex_param(data) -> str:
@@ -31,6 +58,29 @@ def coerce_hex_param(data) -> str:
         if len(data) % 2:
             data = "0" + data
     return data
+
+
+def hexbytes_param(data) -> bytes:
+    """HexBytes-typed RPC param (abci_query data): hex string from
+    JSON-RPC (the reference's HexBytes JSON encoding), while the URI
+    handler passes "quoted" values as RAW content and 0x-values as
+    hex. Shared with the light proxy's verified abci_query."""
+    if isinstance(data, bytes):
+        return data
+    if isinstance(data, RawStr):
+        return data.encode()
+    data = coerce_hex_param(data)
+    if not data:
+        return b""
+    if data.startswith("0x"):
+        data = data[2:]
+    try:
+        return bytes.fromhex(data)
+    except ValueError as e:
+        raise RPCError(
+            -32602,
+            'invalid hex-bytes param: expected hex, 0x-hex, or a '
+            '"quoted" raw string (URI)') from e
 
 
 def _b64(b: bytes) -> str:
@@ -396,9 +446,8 @@ class Environment:
 
     async def abci_query(self, ctx, path="", data="", height=0,
                          prove=False) -> dict:
-        data = coerce_hex_param(data)
         res = await self.node.proxy_app.query.query(abci.RequestQuery(
-            data=bytes.fromhex(data) if data else b"",
+            data=hexbytes_param(data),
             path=path, height=int(height), prove=bool(prove)))
         out = {
             "code": res.code, "log": res.log, "index": str(res.index),
@@ -414,7 +463,7 @@ class Environment:
     # -- txs --
 
     async def broadcast_tx_async(self, ctx, tx="") -> dict:
-        raw = base64.b64decode(tx)
+        raw = _tx_bytes(tx)
         # hold a strong ref: the loop only weak-refs tasks, and a GC'd
         # task would silently drop the tx
         task = asyncio.get_running_loop().create_task(
@@ -431,7 +480,7 @@ class Environment:
             return e
 
     async def broadcast_tx_sync(self, ctx, tx="") -> dict:
-        raw = base64.b64decode(tx)
+        raw = _tx_bytes(tx)
         try:
             res = await self.node.mempool.check_tx(raw)
         except Exception as e:
@@ -444,7 +493,7 @@ class Environment:
         (reference: rpc/core/mempool.go CheckTx)."""
         from ..abci.types import RequestCheckTx
 
-        raw = base64.b64decode(tx)
+        raw = _tx_bytes(tx)
         res = await self.node.proxy_app.mempool.check_tx(
             RequestCheckTx(raw))
         return {"code": res.code, "data": _b64(res.data or b""),
@@ -476,7 +525,7 @@ class Environment:
     async def broadcast_tx_commit(self, ctx, tx="") -> dict:
         """CheckTx, then wait for the tx to land in a block
         (reference: rpc/core/mempool.go BroadcastTxCommit)."""
-        raw = base64.b64decode(tx)
+        raw = _tx_bytes(tx)
         h = tmhash.sum256(raw)
         bus = self.node.event_bus
         subscriber = f"tx-commit-{h.hex()[:16]}"
@@ -617,7 +666,7 @@ class Environment:
     async def broadcast_evidence(self, ctx, evidence="") -> dict:
         from ..types.evidence import evidence_from_bytes
 
-        ev = evidence_from_bytes(base64.b64decode(evidence))
+        ev = evidence_from_bytes(_tx_bytes(evidence))
         self.node.evpool.add_evidence(ev)
         return {"hash": _hex(ev.hash())}
 

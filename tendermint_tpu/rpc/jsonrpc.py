@@ -301,6 +301,26 @@ class _Ctx:
         self.ws = ws
 
 
+class RawStr(str):
+    """A URI param that arrived in explicit quotes (`tx="vk=v"`).
+
+    The reference's URI handler decodes quoted values as RAW content
+    while the JSON-RPC path carries byte params base64-encoded
+    (rpc/jsonrpc/server/http_uri_handler.go vs JSON unmarshalling).
+    Handlers with byte-typed params need that provenance to pick the
+    right decoding — this marker carries it across the generic
+    param-coercion boundary."""
+
+
+class UriStr(str):
+    """An UNQUOTED string param that arrived via the URI interface.
+
+    Byte-typed handlers accept `0x`-hex only from URI values (the
+    reference's URI-handler convention); a JSON-RPC base64 payload
+    that merely LOOKS like 0x-hex must never be hex-decoded, so the
+    0x branch is gated on this provenance marker."""
+
+
 def _uri_param(v: str):
     """URI params arrive as strings; JSON-ify the obvious scalars
     (reference uri handler's type coercion). Int-coerce ONLY when the
@@ -310,12 +330,12 @@ def _uri_param(v: str):
     if v in ("true", "false"):
         return v == "true"
     if v.startswith('"') and v.endswith('"') and len(v) >= 2:
-        return v[1:-1]
+        return RawStr(v[1:-1])
     try:
         n = int(v)
     except ValueError:
-        return v
-    return n if str(n) == v else v
+        return UriStr(v)
+    return n if str(n) == v else UriStr(v)
 
 
 # --- clients ------------------------------------------------------------------

@@ -194,6 +194,37 @@ def test_rpc_uri_and_batch(tmp_path):
             out = json.loads(body)
             assert isinstance(out, list) and len(out) == 2
             assert out[1]["result"]["node_info"]["moniker"] == "rpc-node"
+
+            async def uri_get(path: str) -> dict:
+                r, w = await asyncio.open_connection(
+                    "127.0.0.1", node.rpc_port)
+                w.write(b"GET " + path.encode() + b" HTTP/1.1\r\n"
+                        b"Host: x\r\nConnection: close\r\n\r\n")
+                await w.drain()
+                raw = await r.read(-1)
+                w.close()
+                _, _, body = raw.partition(b"\r\n\r\n")
+                return json.loads(body)
+
+            # Byte params over the URI interface (reference uri
+            # handler): a "quoted" value is RAW tx bytes — the
+            # documented `curl '...?tx="k=v"'` usage — and 0x-hex
+            # decodes as hex. Both must reach the chain.
+            resp = await uri_get('/broadcast_tx_commit?tx="uk=uv"')
+            assert resp["result"]["deliver_tx"]["code"] == 0
+            resp = await uri_get("/broadcast_tx_commit?tx=0x686b3d6876")
+            assert resp["result"]["deliver_tx"]["code"] == 0  # "hk=hv"
+            q = await uri_get('/abci_query?data="hk"')
+            assert base64.b64decode(
+                q["result"]["response"]["value"]) == b"hv"
+            # JSON-RPC POST path still takes hex for HexBytes params.
+            cli = HTTPClient("127.0.0.1", node.rpc_port)
+            q = await cli.call(
+                "abci_query", data=b"hk".hex())
+            assert base64.b64decode(q["response"]["value"]) == b"hv"
+            # Malformed byte param is a -32602 error, not a 500.
+            bad = await uri_get("/broadcast_tx_sync?tx=notb64!!")
+            assert bad["error"]["code"] == -32602
         finally:
             await node.stop()
 
