@@ -108,7 +108,10 @@ WINDOWS_PER_ITER = int(__import__("os").environ.get(
 
 
 @functools.cache
-def _xkernel(wpi: int = WINDOWS_PER_ITER):
+def _xcore(wpi: int = WINDOWS_PER_ITER):
+    """The shared verify body: everything after the (N, W) message
+    buffer exists on device. Both front-ends — bytes (`_xkernel`) and
+    structured template+patch (`_skernel`) — trace through this."""
     import jax
     import jax.numpy as jnp
 
@@ -119,8 +122,7 @@ def _xkernel(wpi: int = WINDOWS_PER_ITER):
 
     assert _WINDOWS % wpi == 0, "windows-per-iter must divide 69"
 
-    @jax.jit
-    def kernel(idx, akeys, sb, msg, nblocks, s_ok, key_ok, atab, btab):
+    def core(idx, akeys, sb, msg, nblocks, s_ok, key_ok, atab, btab):
         n = idx.shape[0]
         # Pubkey bytes gathered from the device-resident key array —
         # the host sends (N,) indices, not (N, 32) pubkey rows.
@@ -197,7 +199,77 @@ def _xkernel(wpi: int = WINDOWS_PER_ITER):
             & key_ok[idx]
         )
 
+    return core
+
+
+@functools.cache
+def _xkernel(wpi: int = WINDOWS_PER_ITER):
+    import jax
+
+    core = _xcore(wpi)
+
+    @jax.jit
+    def kernel(idx, akeys, sb, msg, nblocks, s_ok, key_ok, atab, btab):
+        return core(idx, akeys, sb, msg, nblocks, s_ok, key_ok, atab, btab)
+
     return kernel
+
+
+@functools.cache
+def _skernel(wpi: int = WINDOWS_PER_ITER):
+    """Structured front-end: assemble the (N, width) message buffer ON
+    DEVICE from commit-wide templates plus a <=24-byte per-lane
+    timestamp patch (types/sign_batch.py layout:
+    outer_varint ‖ pre[group] ‖ ts_field ‖ suf[group], then SHA-512
+    padding). Per-lane transfer drops from ~190 B of sign bytes to the
+    patch + two ints; the templates ship once per launch."""
+    import jax
+    import jax.numpy as jnp
+
+    core = _xcore(wpi)
+
+    @functools.partial(jax.jit, static_argnames=("width",))
+    def skernel(idx, akeys, sb, s_ok, key_ok, atab, btab,
+                pre, pre_len, suf, suf_len, patch, split, patch_len,
+                group, *, width):
+        n = idx.shape[0]
+        j = jnp.arange(width, dtype=jnp.int32)[None, :]       # (1, W)
+        p_len = pre_len[group][:, None]                       # (N, 1)
+        s_len = suf_len[group][:, None]
+        a = split[:, None].astype(jnp.int32)
+        b = (patch_len - split)[:, None].astype(jnp.int32)
+        c1 = a + p_len
+        c2 = c1 + b
+        c3 = c2 + s_len                                       # = mlen
+        pre_g = pre[group].astype(jnp.int32)                  # (N, PW)
+        suf_g = suf[group].astype(jnp.int32)
+        patch_i = patch.astype(jnp.int32)
+
+        def gat(src, col):
+            return jnp.take_along_axis(
+                src, jnp.clip(col, 0, src.shape[1] - 1), axis=1)
+
+        msg = jnp.where(
+            j < a, gat(patch_i, j),
+            jnp.where(j < c1, gat(pre_g, j - a),
+                      jnp.where(j < c2, gat(patch_i, a + (j - c1)),
+                                jnp.where(j < c3, gat(suf_g, j - c2),
+                                          0))))
+        msg = jnp.where(j == c3, 0x80, msg)
+        # SHA-512 padding tail: 16-byte big-endian bit length at the
+        # end of the lane's last block (bit length < 2^13 here, so
+        # only the low 2 bytes are ever nonzero).
+        mlen = c3
+        nblocks = (64 + mlen + 17 + 127) // 128               # (N, 1)
+        bitlen = (64 + mlen) * 8
+        k = 15 - (j - (nblocks * 128 - 16 - 64))              # 15..0
+        lenbyte = jnp.where(k < 4, (bitlen >> (8 * jnp.clip(k, 0, 3)))
+                            & 0xFF, 0)
+        msg = jnp.where((k >= 0) & (k < 16), lenbyte, msg)
+        return core(idx, akeys, sb, msg.astype(jnp.uint8),
+                    nblocks[:, 0], s_ok, key_ok, atab, btab)
+
+    return skernel
 
 
 class ExpandedKeys:
@@ -267,55 +339,68 @@ class ExpandedKeys:
     def __len__(self) -> int:
         return len(self.pubkeys)
 
+    def _check_idx(self, indices, n_sigs) -> np.ndarray:
+        n = len(indices)
+        assert n_sigs == n
+        idx = np.asarray(indices, np.int32)
+        assert n <= tv._MAX_BATCH, "split huge batches at the call site"
+        assert idx.min() >= 0 and idx.max() < len(self.pubkeys)
+        return idx
+
+    @staticmethod
+    def _sig_rows(sigs, pad: int) -> tuple[np.ndarray, np.ndarray]:
+        """(bucket, 64) signature rows + per-lane well-formedness.
+
+        Per-lane length check, vectorized (map(len) runs the loop in
+        C). An AGGREGATE total-length shortcut would be unsound:
+        two adjacent malformed sigs of 63+65 bytes cancel out and
+        every following lane's bytes shift — an accept/reject
+        divergence between nodes on adversarial commits."""
+        n = len(sigs)
+        lens = np.fromiter(map(len, sigs), np.int64, count=n)
+        well_formed = lens == 64
+        if not well_formed.all():
+            sigs = [s if ok else b"\0" * 64
+                    for s, ok in zip(sigs, well_formed)]
+        joined = b"".join(sigs) + b"\0" * (64 * pad)
+        return (np.frombuffer(joined, np.uint8).reshape(n + pad, 64),
+                well_formed)
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        """Powers of two up to 1024, then multiples of 1024 (a
+        10,240-lane commit runs at exactly 10,240 instead of padding
+        1.6x to 16,384; valset sizes are stable so the shape cache
+        stays small)."""
+        if n <= 1024:
+            bucket = tv._MIN_BATCH
+            while bucket < n:
+                bucket <<= 1
+            return bucket
+        return (n + 1023) // 1024 * 1024
+
     def _prepare(self, indices, msgs, sigs):
         """Host side of verify: validate, pad to a bucket, pack bytes.
 
         Split from the launch so callers (bench.py) can attribute
         host-packing vs device time separately."""
         n = len(indices)
-        assert len(msgs) == n and len(sigs) == n
-        idx = np.asarray(indices, np.int32)
-        assert n <= tv._MAX_BATCH, "split huge batches at the call site"
-        assert idx.min() >= 0 and idx.max() < len(self.pubkeys)
-        # Per-lane length check, vectorized (map(len) runs the loop in
-        # C). An AGGREGATE total-length shortcut would be unsound:
-        # two adjacent malformed sigs of 63+65 bytes cancel out and
-        # every following lane's bytes shift — an accept/reject
-        # divergence between nodes on adversarial commits.
-        lens = np.fromiter(map(len, sigs), np.int64, count=n)
-        well_formed = lens == 64
-        if well_formed.all():
-            joined = b"".join(sigs)
-        else:
-            sigs = [s if ok else b"\0" * 64
-                    for s, ok in zip(sigs, well_formed)]
-            joined = b"".join(sigs)
-
-        # Bucket: powers of two up to 1024, then multiples of 1024 (a
-        # 10,240-lane commit runs at exactly 10,240 instead of padding
-        # 1.6x to 16,384; valset sizes are stable so the shape cache
-        # stays small).
-        if n <= 1024:
-            bucket = tv._MIN_BATCH
-            while bucket < n:
-                bucket <<= 1
-        else:
-            bucket = (n + 1023) // 1024 * 1024
+        assert len(msgs) == n
+        idx = self._check_idx(indices, len(sigs))
+        bucket = self._bucket(n)
         pad = bucket - n
+        sig_raw, well_formed = self._sig_rows(sigs, pad)
         if pad:
             idx = np.concatenate([idx, np.zeros(pad, np.int32)])
             msgs = list(msgs) + [b""] * pad
-            joined += b"\0" * (64 * pad)
-
-        sig_raw = np.frombuffer(joined, np.uint8).reshape(bucket, 64)
         packed = tv.pack_sig_msg(sig_raw, msgs)
         return idx, packed, well_formed
 
-    def _launch(self, idx, packed):
-        """Device side of verify: one kernel launch over packed lanes,
-        lane-sharded over the ('dp',) mesh when one exists (tables and
-        comb constants replicated; verdict gather is the only
-        cross-chip traffic)."""
+    def _shard_args(self, idx, fields, repl_keys=()):
+        """Shared mesh dispatch for both launch forms: lane-shard the
+        per-lane arrays over the ('dp',) mesh when one exists (tables,
+        comb constants, and any `repl_keys` fields replicated; verdict
+        gather is the only cross-chip traffic)."""
         btab = tv.b_comb_tables()
         mesh = tv._mesh()
         bucket = idx.shape[0]
@@ -325,11 +410,18 @@ class ExpandedKeys:
 
             row_s, vec_s, repl_s = tv._shardings(mesh)
             idx = jax.device_put(idx, vec_s)
-            packed = {
-                k: jax.device_put(v, vec_s if v.ndim == 1 else row_s)
-                for k, v in packed.items()
+            fields = {
+                k: jax.device_put(
+                    v, repl_s if k in repl_keys
+                    else (vec_s if v.ndim == 1 else row_s))
+                for k, v in fields.items()
             }
             btab = jax.device_put(btab, repl_s)
+        return idx, fields, btab
+
+    def _launch(self, idx, packed):
+        """Device side of verify: one kernel launch over packed lanes."""
+        idx, packed, btab = self._shard_args(idx, packed)
         return _xkernel(WINDOWS_PER_ITER)(
             idx=idx,
             akeys=self.akeys,
@@ -350,6 +442,86 @@ class ExpandedKeys:
             return np.zeros(0, bool)
         idx, packed, well_formed = self._prepare(indices, msgs, sigs)
         out = self._launch(idx, packed)
+        return np.asarray(out)[:n] & well_formed
+
+    # -- structured commit path (message bytes assembled on device) --
+
+    # Message-buffer widths (bytes after the 64-byte R||A prefix) the
+    # structured kernel compiles for: 2- and 4-block SHA inputs. Every
+    # realistic vote fits in 192 (mlen <= 175); 448 covers pathological
+    # chain-id/block-id combinations up to the guard below.
+    _S_WIDTHS = (192, 448)
+
+    def _prepare_structured(self, indices, sbatch, sigs):
+        n = len(indices)
+        assert len(sbatch) == n
+        idx = self._check_idx(indices, len(sigs))
+        # Cheap host self-check: the structured reassembly of lane 0
+        # must equal the canonical sign bytes. Catches template-math
+        # drift at the call site instead of verifying wrong bytes.
+        if sbatch.host_assemble(0) != sbatch.commit.vote_sign_bytes(
+                sbatch.chain_id, sbatch.slots[0]):
+            raise ValueError("structured sign-bytes self-check failed")
+        max_len = sbatch.max_msg_len()
+        width = next((w for w in self._S_WIDTHS if max_len <= w - 17),
+                     None)
+        if width is None:
+            raise ValueError("sign bytes too long for structured path")
+        # Fixed template shapes -> one compile per (width, bucket):
+        # K padded to 2 groups, pre to 128 B, suf to 64 B (every legal
+        # vote fits; the guard keeps pathological inputs off this path).
+        k, pw = sbatch.pre.shape
+        sw = sbatch.suf.shape[1]
+        if k > 2 or pw > 128 or sw > 64:
+            raise ValueError("templates too large for structured path")
+        bucket = self._bucket(n)
+        pad = bucket - n
+        sig_raw, well_formed = self._sig_rows(sigs, pad)
+
+        def padded(a, rows):
+            return np.pad(a, ((0, rows),) + ((0, 0),) * (a.ndim - 1))
+
+        if pad:
+            idx = np.concatenate([idx, np.zeros(pad, np.int32)])
+        fields = dict(
+            sb=sig_raw,
+            s_ok=tv.s_range_ok(sig_raw),
+            pre=np.pad(sbatch.pre, ((0, 2 - k), (0, 128 - pw))),
+            pre_len=padded(sbatch.pre_len, 2 - k),
+            suf=np.pad(sbatch.suf, ((0, 2 - k), (0, 64 - sw))),
+            suf_len=padded(sbatch.suf_len, 2 - k),
+            patch=padded(sbatch.patch, pad),
+            split=padded(sbatch.split, pad),
+            patch_len=padded(sbatch.patch_len, pad),
+            group=padded(sbatch.group, pad),
+        )
+        return idx, fields, well_formed, width
+
+    def _launch_structured(self, idx, fields, width):
+        idx, fields, btab = self._shard_args(
+            idx, fields, repl_keys=("pre", "pre_len", "suf", "suf_len"))
+        return _skernel(WINDOWS_PER_ITER)(
+            idx=idx,
+            akeys=self.akeys,
+            key_ok=self.key_ok,
+            atab=self.tables,
+            btab=btab,
+            width=width,
+            **fields,
+        )
+
+    def verify_structured(self, indices, sbatch, sigs) -> np.ndarray:
+        """verify() for commit votes in structured form: identical
+        verdicts to verify(indices, sbatch.materialize(), sigs), but
+        the device assembles the sign bytes from the commit-wide
+        template + per-lane timestamp patch (types/sign_batch.py), so
+        the launch ships ~100 B/lane instead of ~330 B/lane."""
+        n = len(indices)
+        if n == 0:
+            return np.zeros(0, bool)
+        idx, fields, well_formed, width = self._prepare_structured(
+            indices, sbatch, sigs)
+        out = self._launch_structured(idx, fields, width)
         return np.asarray(out)[:n] & well_formed
 
 

@@ -127,7 +127,6 @@ def pack_sig_msg(sig_raw: np.ndarray, msgs) -> dict[str, np.ndarray]:
     (32 B/lane — ~330 KB per 10,240-lane commit through the relay)."""
     from . import sha512 as sh
 
-    n = sig_raw.shape[0]
     msg_pad, nblocks = sh.pad_messages(list(msgs), prefix_len=64)
     # Bucket the padded width to power-of-two block counts so kernel
     # shapes (and recompiles) stay bounded; extra blocks are zeros and
@@ -139,18 +138,25 @@ def pack_sig_msg(sig_raw: np.ndarray, msgs) -> dict[str, np.ndarray]:
     if tb != total_blocks:
         msg_pad = np.pad(msg_pad, ((0, 0), (0, (tb - total_blocks) * 128)))
 
+    return dict(
+        sb=sig_raw,
+        msg=msg_pad,
+        nblocks=nblocks,
+        s_ok=s_range_ok(sig_raw),
+    )
+
+
+def s_range_ok(sig_raw: np.ndarray) -> np.ndarray:
+    """Per-lane S < L check on (N, 64) signature rows (host-side; the
+    kernel takes the verdict as an input mask)."""
+    n = sig_raw.shape[0]
     s_words = sig_raw[:, 32:].copy().view(np.uint64)  # (n, 4) LE words
     lt = np.zeros(n, bool)
     gt = np.zeros(n, bool)
     for w in (3, 2, 1, 0):
         lt |= ~gt & ~lt & (s_words[:, w] < _L_WORDS[w])
         gt |= ~gt & ~lt & (s_words[:, w] > _L_WORDS[w])
-    return dict(
-        sb=sig_raw,
-        msg=msg_pad,
-        nblocks=nblocks,
-        s_ok=lt,
-    )
+    return lt
 
 
 @functools.cache

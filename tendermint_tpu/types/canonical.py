@@ -59,6 +59,39 @@ def vote_sign_bytes(chain_id: str, vote_type: int, height: int, round_: int,
     return encode_varint(len(body)) + body
 
 
+def vote_sign_parts(chain_id: str, vote_type: int, height: int,
+                    round_: int, block_id) -> tuple[bytes, bytes]:
+    """The timestamp-independent halves of vote sign bytes.
+
+    For ANY time_ns:
+        vote_sign_bytes(...) ==
+            encode_varint(len(pre) + len(tsf) + len(suf)) + pre + tsf + suf
+    with tsf = ts_field_bytes(time_ns). Built with the exact same
+    Writer calls as vote_sign_bytes, so the invariant holds by
+    construction (tests enforce it across edge cases). Within one
+    commit every signature shares (pre, suf) — only the timestamp
+    field and the outer length prefix differ per lane — which is what
+    lets commit verification ship a template plus per-lane timestamp
+    patches to the device instead of full per-lane sign bytes."""
+    w = Writer()
+    w.varint(1, vote_type)
+    w.sfixed64(2, height)
+    w.sfixed64(3, round_)
+    w.message(4, canonical_block_id_writer(block_id))
+    pre = w.finish()
+    w = Writer()
+    w.string(6, chain_id)
+    return pre, w.finish()
+
+
+def ts_field_bytes(time_ns: int) -> bytes:
+    """Wire bytes of canonical-vote field 5 (the Timestamp message);
+    empty when time_ns == 0 (absent field, proto3 canonical form)."""
+    w = Writer()
+    w.message(5, timestamp_writer(time_ns))
+    return w.finish()
+
+
 def strip_canonical_timestamp(sign_bytes: bytes, ts_field: int) -> bytes:
     """Re-emit a length-prefixed canonical blob with the timestamp field
     removed — used to decide whether two sign-byte blobs differ only by

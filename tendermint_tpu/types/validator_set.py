@@ -27,6 +27,8 @@ PRIORITY_WINDOW_SIZE_FACTOR = 2
 _EXPAND_MIN = 128
 
 
+
+
 class VerificationError(Exception):
     pass
 
@@ -218,24 +220,70 @@ class ValidatorSet:
 
     # -- commit verification (batched; the hot path) --
 
-    def _batch_verify_lanes(self, lanes: list[int], msgs: list[bytes],
+    def _use_expanded(self, lanes: list[int]) -> bool:
+        """Will _batch_verify_lanes take the expanded device path?"""
+        from ..crypto import batch as _batch
+
+        return (len(lanes) >= _EXPAND_MIN and _batch.device_available()
+                and all(self.validators[i].pub_key.type_name == "ed25519"
+                        for i in lanes))
+
+    def _commit_msgs(self, chain_id: str, commit, slots: list[int],
+                     lanes: list[int]):
+        """Sign bytes for the given commit slots: structured form
+        (types/sign_batch.py — the device assembles the bytes, so the
+        launch skips shipping full per-lane rows) when the expanded
+        device path will consume it, a plain materialized list
+        otherwise (small sets / host fallback would just throw the
+        structure away) or when the commit's values don't fit the
+        vectorized layout (e.g. a hostile timestamp past int64)."""
+        if not slots:
+            return []
+        if self._use_expanded(lanes):
+            from .sign_batch import CommitSignBatch
+
+            try:
+                return CommitSignBatch(chain_id, commit, slots)
+            except ValueError:
+                pass
+        return [commit.vote_sign_bytes(chain_id, s) for s in slots]
+
+    def _batch_verify_lanes(self, lanes: list[int], msgs,
                             sigs: list[bytes]):
         """One device batch over (self.validators[lanes[i]], msgs[i],
         sigs[i]). Large all-ed25519 sets go through the expanded
         per-validator comb tables (cached on device across heights —
         see crypto/tpu/expanded.py); everything else through the
-        general BatchVerifier."""
-        from ..crypto import batch as _batch
+        general BatchVerifier.
 
-        if len(lanes) >= _EXPAND_MIN and _batch.device_available() and \
-                all(self.validators[i].pub_key.type_name == "ed25519"
-                    for i in lanes):
+        msgs is either a list of sign-byte blobs or a
+        types.sign_batch.CommitSignBatch: the structured form lets the
+        expanded path assemble the bytes ON DEVICE (template +
+        per-lane timestamp patch) instead of shipping ~190 B of
+        redundant sign bytes per lane; every fallback materializes the
+        identical full bytes."""
+        from ..crypto import batch as _batch
+        from .sign_batch import CommitSignBatch
+
+        structured = isinstance(msgs, CommitSignBatch)
+        if self._use_expanded(lanes):
             from ..crypto.tpu import expanded
 
             try:
                 exp = expanded.get_expanded(
                     [v.pub_key.bytes() for v in self.validators])
-                verdicts = exp.verify(lanes, msgs, sigs)
+                if structured:
+                    try:
+                        verdicts = exp.verify_structured(
+                            lanes, msgs, sigs)
+                    except ValueError:
+                        # structural limit (oversized templates /
+                        # sign bytes), NOT a device failure: same
+                        # device, full-bytes form
+                        verdicts = exp.verify(
+                            lanes, msgs.materialize(), sigs)
+                else:
+                    verdicts = exp.verify(lanes, msgs, sigs)
                 return bool(verdicts.all()), verdicts
             except Exception:
                 # dead device mid-table-build or mid-launch: degrade
@@ -245,6 +293,8 @@ class ValidatorSet:
                 _batch.logger.exception(
                     "expanded-valset verify failed (%d lanes); "
                     "degrading", len(lanes))
+        if structured:
+            msgs = msgs.materialize()
         bv = BatchVerifier()
         for i, m, s in zip(lanes, msgs, sigs):
             bv.add(self.validators[i].pub_key, m, s)
@@ -256,7 +306,6 @@ class ValidatorSet:
         exceed 2/3 (reference: validator_set.go:662)."""
         self._check_commit_basics(block_id, height, commit)
         lanes: list[int] = []
-        msgs: list[bytes] = []
         sigs: list[bytes] = []
         tallied = 0
         for idx, cs in enumerate(commit.signatures):
@@ -268,10 +317,10 @@ class ValidatorSet:
                     f"wrong validator address in slot {idx}"
                 )
             lanes.append(idx)
-            msgs.append(commit.vote_sign_bytes(chain_id, idx))
             sigs.append(cs.signature)
             if cs.for_block():
                 tallied += val.voting_power
+        msgs = self._commit_msgs(chain_id, commit, lanes, lanes)
         ok, verdicts = self._batch_verify_lanes(lanes, msgs, sigs)
         if not ok:
             bad = [lanes[i] for i in range(len(lanes)) if not verdicts[i]]
@@ -287,7 +336,6 @@ class ValidatorSet:
         (reference: validator_set.go:720) — as one batch."""
         self._check_commit_basics(block_id, height, commit)
         lanes: list[int] = []
-        msgs: list[bytes] = []
         sigs: list[bytes] = []
         tallied = 0
         need = 2 * self.total_voting_power()
@@ -296,7 +344,6 @@ class ValidatorSet:
                 continue
             val = self.validators[idx]
             lanes.append(idx)
-            msgs.append(commit.vote_sign_bytes(chain_id, idx))
             sigs.append(cs.signature)
             tallied += val.voting_power
             if 3 * tallied > need:
@@ -305,6 +352,7 @@ class ValidatorSet:
             raise VerificationError(
                 f"insufficient voting power: {tallied} of {self.total_voting_power()}"
             )
+        msgs = self._commit_msgs(chain_id, commit, lanes, lanes)
         ok, verdicts = self._batch_verify_lanes(lanes, msgs, sigs)
         if not ok:
             bad = [lanes[i] for i in range(len(lanes)) if not verdicts[i]]
@@ -318,8 +366,7 @@ class ValidatorSet:
         if trust_den <= 0 or trust_num <= 0 or trust_num > trust_den:
             raise ValueError("invalid trust level")
         lanes: list[int] = []  # OUR validator indices (for the tables)
-        slots: list[int] = []  # commit slots (for error reporting)
-        msgs: list[bytes] = []
+        slots: list[int] = []  # commit slots (for sign bytes/errors)
         sigs: list[bytes] = []
         tallied = 0
         need = self.total_voting_power() * trust_num
@@ -335,7 +382,6 @@ class ValidatorSet:
             seen.add(vi)
             lanes.append(vi)
             slots.append(idx)
-            msgs.append(commit.vote_sign_bytes(chain_id, idx))
             sigs.append(cs.signature)
             tallied += val.voting_power
             if tallied * trust_den > need:
@@ -344,6 +390,7 @@ class ValidatorSet:
             raise VerificationError(
                 f"insufficient trusted power: {tallied}"
             )
+        msgs = self._commit_msgs(chain_id, commit, slots, lanes)
         ok, verdicts = self._batch_verify_lanes(lanes, msgs, sigs)
         if not ok:
             bad = [slots[i] for i in range(len(slots)) if not verdicts[i]]

@@ -1,0 +1,117 @@
+"""CommitSignBatch: structured sign bytes must equal canonical bytes.
+
+The structured commit path ships a template + per-lane timestamp patch
+to the device instead of full sign-byte rows (types/sign_batch.py);
+consensus safety rests on the reassembly being BYTE-IDENTICAL to
+types/canonical.py vote_sign_bytes (reference types/canonical.go) for
+every lane. These tests sweep the encoding edge cases: ts=0 (absent
+field), nanos=0 / secs=0 (absent subfields), varint width boundaries,
+nil-vote lanes (absent block_id → second template group), long chain
+ids pushing the outer length prefix to two bytes, and tiny commits.
+"""
+
+import random
+
+from tendermint_tpu.types.block import (
+    BlockID, BlockIDFlag, Commit, CommitSig, PartSetHeader,
+)
+from tendermint_tpu.types.sign_batch import CommitSignBatch
+
+
+def _mk_commit(chain_id, height, round_, ts_list, flags=None):
+    bid = BlockID(
+        hash=bytes(range(32)),
+        part_set_header=PartSetHeader(3, bytes(reversed(range(32)))),
+    )
+    sigs = []
+    for i, ts in enumerate(ts_list):
+        flag = (flags[i] if flags else BlockIDFlag.COMMIT)
+        if flag == BlockIDFlag.ABSENT:
+            sigs.append(CommitSig.absent())
+        else:
+            sigs.append(CommitSig(
+                block_id_flag=flag,
+                validator_address=bytes([i % 256] * 20),
+                timestamp=ts,
+                signature=b"\x01" * 64,
+            ))
+    return Commit(height=height, round=round_, block_id=bid,
+                  signatures=sigs)
+
+
+EDGE_TS = [
+    0,                        # absent timestamp field
+    1,                        # secs absent, 1-byte nanos
+    127, 128,                 # nanos varint width boundary
+    999_999_999,              # max nanos, secs absent
+    1_000_000_000,            # 1-byte secs, nanos absent
+    1_000_000_001,            # both present
+    127 * 1_000_000_000,      # secs varint boundary
+    128 * 1_000_000_000,
+    1_753_928_000_123_456_789,  # realistic current epoch
+    (1 << 30) * 1_000_000_000 + 5,  # wide (5-byte) secs varint
+]
+
+
+def _assert_batch_matches(chain_id, commit, slots):
+    sb = CommitSignBatch(chain_id, commit, slots)
+    want = sb.materialize()
+    for i in range(len(slots)):
+        got = sb.host_assemble(i)
+        assert got == want[i], (
+            f"lane {i} (slot {slots[i]}): structured reassembly "
+            f"diverges\n got={got.hex()}\nwant={want[i].hex()}")
+    lens = sb.msg_lens()
+    assert [int(x) for x in lens] == [len(w) for w in want]
+
+
+def test_edge_timestamps_byte_identical():
+    commit = _mk_commit("edge-chain", 7, 2, EDGE_TS)
+    _assert_batch_matches("edge-chain", commit, list(range(len(EDGE_TS))))
+
+
+def test_nil_votes_second_group():
+    flags = [BlockIDFlag.COMMIT, BlockIDFlag.NIL, BlockIDFlag.COMMIT,
+             BlockIDFlag.NIL]
+    ts = [10**18 + 17, 10**18 + 23, 5, 0]
+    commit = _mk_commit("two-groups", 99, 0, ts, flags)
+    sb = CommitSignBatch("two-groups", commit, [0, 1, 2, 3])
+    assert len(set(sb.group.tolist())) == 2
+    _assert_batch_matches("two-groups", commit, [0, 1, 2, 3])
+
+
+def test_long_chain_id_two_byte_outer():
+    chain = "x" * 50  # MaxChainIDLen — pushes body past 127 bytes
+    commit = _mk_commit(chain, 1 << 40, 33, EDGE_TS)
+    sb = CommitSignBatch(chain, commit, list(range(len(EDGE_TS))))
+    assert int(sb.split.max()) == 2  # two-byte outer varint exercised
+    _assert_batch_matches(chain, commit, list(range(len(EDGE_TS))))
+
+
+def test_randomized_sweep():
+    rng = random.Random(42)
+    for trial in range(30):
+        chain = "c" * rng.randint(1, 50)
+        height = rng.choice([1, 2, 1000, 1 << 32, (1 << 62)])
+        round_ = rng.choice([0, 1, 7, 1 << 20])
+        n = rng.randint(1, 40)
+        ts = [rng.choice(EDGE_TS + [rng.getrandbits(60)])
+              for _ in range(n)]
+        flags = [rng.choice([BlockIDFlag.COMMIT, BlockIDFlag.COMMIT,
+                             BlockIDFlag.NIL]) for _ in range(n)]
+        commit = _mk_commit(chain, height, round_, ts, flags)
+        _assert_batch_matches(chain, commit, list(range(n)))
+
+
+def test_out_of_range_timestamp_rejected():
+    import pytest
+
+    commit = _mk_commit("far", 5, 1, [(1 << 40) * 1_000_000_000])
+    with pytest.raises(ValueError):
+        CommitSignBatch("far", commit, [0])
+
+
+def test_subset_of_slots():
+    commit = _mk_commit("subset", 5, 1, EDGE_TS)
+    slots = [1, 3, 8]
+    _assert_batch_matches("subset", commit, slots)
