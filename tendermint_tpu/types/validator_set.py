@@ -27,8 +27,6 @@ PRIORITY_WINDOW_SIZE_FACTOR = 2
 _EXPAND_MIN = 128
 
 
-
-
 class VerificationError(Exception):
     pass
 
@@ -266,7 +264,9 @@ class ValidatorSet:
         from .sign_batch import CommitSignBatch
 
         structured = isinstance(msgs, CommitSignBatch)
-        if self._use_expanded(lanes):
+        # structured implies _use_expanded held when the batch was
+        # built (_commit_msgs) — don't repeat the O(n) key-type scan.
+        if structured or self._use_expanded(lanes):
             from ..crypto.tpu import expanded
 
             try:
@@ -279,7 +279,15 @@ class ValidatorSet:
                     except ValueError:
                         # structural limit (oversized templates /
                         # sign bytes), NOT a device failure: same
-                        # device, full-bytes form
+                        # device, full-bytes form. Logged loudly —
+                        # if this is the lane-0 reassembly self-check
+                        # firing, the structured path has a template
+                        # bug that must surface, not hide behind a
+                        # working fallback.
+                        _batch.logger.exception(
+                            "structured commit verify rejected the "
+                            "batch (%d lanes); using full-bytes form",
+                            len(lanes))
                         verdicts = exp.verify(
                             lanes, msgs.materialize(), sigs)
                 else:
