@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import functools
 import hashlib
+import threading
 from collections import OrderedDict
 
 import numpy as np
@@ -529,16 +530,65 @@ class ExpandedKeys:
 
 _CACHE: OrderedDict[bytes, ExpandedKeys] = OrderedDict()
 _CACHE_MAX = 2
+# _CACHE_LOCK guards only the dict (fast ops). Builds are serialized
+# PER KEY via _BUILDS events: a background warm (warm_async) racing a
+# commit verify must not build the same multi-GB table twice — at 10k
+# keys two concurrent builds' transients approach chip HBM — but a
+# cache HIT for a different (already-built) valset must never wait
+# behind another key's multi-second build.
+_CACHE_LOCK = threading.Lock()
+_BUILDS: dict[bytes, threading.Event] = {}
 
 
 def get_expanded(pubkeys: list[bytes]) -> ExpandedKeys:
     key = hashlib.sha256(b"".join(pubkeys)).digest()
-    exp = _CACHE.get(key)
-    if exp is None:
+    while True:
+        with _CACHE_LOCK:
+            exp = _CACHE.get(key)
+            if exp is not None:
+                _CACHE.move_to_end(key)
+                return exp
+            ev = _BUILDS.get(key)
+            if ev is None:
+                ev = threading.Event()
+                _BUILDS[key] = ev
+                break  # this thread builds
+        # Another thread is building this exact key: wait, then loop —
+        # either the table is cached now, or the builder failed and
+        # this thread claims the build itself.
+        ev.wait()
+    try:
         exp = ExpandedKeys(pubkeys)
-        _CACHE[key] = exp
-        while len(_CACHE) > _CACHE_MAX:
-            _CACHE.popitem(last=False)
-    else:
-        _CACHE.move_to_end(key)
-    return exp
+        with _CACHE_LOCK:
+            _CACHE[key] = exp
+            while len(_CACHE) > _CACHE_MAX:
+                _CACHE.popitem(last=False)
+        return exp
+    finally:
+        with _CACHE_LOCK:
+            _BUILDS.pop(key, None)
+        ev.set()
+
+
+def warm_async(pubkeys: list[bytes]) -> threading.Thread:
+    """Build (or touch) the expanded tables for a valset in a
+    background thread, so the first commit verify after a validator
+    -set change doesn't pay the multi-second table build inline.
+    In consensus the NEXT valset is known two heights ahead
+    (state/execution.py update_state; reference state/execution.go:406)
+    — exactly the window this hides the build in. Returns the thread
+    (callers/tests may join; the node fires and forgets)."""
+
+    def build():
+        try:
+            get_expanded(pubkeys)
+        except Exception:  # pragma: no cover - depends on device state
+            from .. import batch as _batch
+
+            _batch.logger.exception(
+                "background expanded-table warm failed (%d keys)",
+                len(pubkeys))
+
+    t = threading.Thread(target=build, name="expanded-warm", daemon=True)
+    t.start()
+    return t

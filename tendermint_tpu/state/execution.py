@@ -156,6 +156,11 @@ class BlockExecutor:
         val_updates = validator_updates_from_abci(end_block.validator_updates)
         new_state = update_state(state, block_id, block, abci_responses,
                                  val_updates)
+        if val_updates:
+            # The changed set takes effect at H+2: warm its expanded
+            # device tables in the background now so the first commit
+            # verify under it doesn't pay the table build inline.
+            new_state.next_validators.warm_device_tables()
 
         # Commit app + update mempool (reference: execution.go:210-254)
         app_hash, retain_height = await self._commit(new_state, block,

@@ -226,6 +226,19 @@ class ValidatorSet:
                 and all(self.validators[i].pub_key.type_name == "ed25519"
                         for i in lanes))
 
+    def warm_device_tables(self):
+        """Kick a background build of this set's expanded device
+        tables (crypto/tpu/expanded.py warm_async) if commit verifies
+        for it would use them. Called when a validator-set change is
+        adopted so the first commit under the new set doesn't pay the
+        table build inline. Returns the thread or None."""
+        if not self._use_expanded(list(range(len(self.validators)))):
+            return None
+        from ..crypto.tpu import expanded
+
+        return expanded.warm_async(
+            [v.pub_key.bytes() for v in self.validators])
+
     def _commit_msgs(self, chain_id: str, commit, slots: list[int],
                      lanes: list[int]):
         """Sign bytes for the given commit slots: structured form

@@ -224,3 +224,45 @@ def test_expanded_chunked_build_matches_single():
                      [msgs[i] for i in perm],
                      [sigs[i] for i in perm])
     assert (got == want[perm]).all()
+
+
+def test_warm_async_prebuilds_cache():
+    """warm_async builds tables in a background thread; the verify
+    that follows reuses the SAME cached object (no rebuild), and the
+    build lock serializes a racing get_expanded with the warm."""
+    import hashlib
+
+    from tendermint_tpu.crypto import ed25519_ref as ref
+    from tendermint_tpu.crypto.tpu import expanded as ex
+
+    n = 8
+    seeds = [hashlib.sha256(b"wm%d" % i).digest() for i in range(n)]
+    pubs = [ref.public_key_from_seed(s) for s in seeds]
+    t = ex.warm_async(pubs)
+    # racing lookup while the warm may still be building
+    racing = ex.get_expanded(pubs)
+    t.join(timeout=300)
+    assert not t.is_alive()
+    assert ex.get_expanded(pubs) is racing  # one build, one object
+    msgs = [b"warm %d" % i for i in range(n)]
+    sigs = [ref.sign(s, m) for s, m in zip(seeds, msgs)]
+    assert bool(racing.verify(list(range(n)), msgs, sigs).all())
+
+
+def test_warm_device_tables_gating():
+    """ValidatorSet.warm_device_tables fires only for large
+    all-ed25519 sets with a live device path."""
+    import hashlib
+
+    from tendermint_tpu.crypto import ed25519_ref as ref
+    from tendermint_tpu.crypto.ed25519 import Ed25519PubKey
+    from tendermint_tpu.types.validator import Validator
+    from tendermint_tpu.types.validator_set import ValidatorSet
+
+    small = ValidatorSet([
+        Validator(address=(p := Ed25519PubKey(ref.public_key_from_seed(
+            hashlib.sha256(b"wg%d" % i).digest()))).address(),
+            pub_key=p, voting_power=1)
+        for i in range(4)
+    ])
+    assert small.warm_device_tables() is None  # below _EXPAND_MIN
