@@ -7,10 +7,12 @@ blocks until caught up, then hands off to consensus
 TPU-first redesign of the hot loop: the reference verifies one commit
 per block (`VerifyCommitLight`, sequential per-sig). Here a contiguous
 window of fetched blocks is verified as ONE signature batch
-(`_batch_verify_window`) — every (pubkey, signbytes, sig) triple from
-up to BATCH_WINDOW commits goes to the device in a single
-BatchVerifier call, amortizing dispatch and filling MXU lanes
-(SURVEY §3.5: batch across blocks, not just within a commit)."""
+(`_batch_verify_window`): up to BATCH_WINDOW commits go to the device
+in a single launch, amortizing dispatch and filling the lanes (SURVEY
+§3.5: batch across blocks, not just within a commit). Large valsets
+ride the expanded comb tables with device-assembled STRUCTURED sign
+bytes — one template group per block's commit — via
+ValidatorSet._batch_verify_lanes."""
 
 from __future__ import annotations
 
@@ -18,7 +20,6 @@ import asyncio
 import logging
 import time
 
-from ..crypto.batch import BatchVerifier
 from ..p2p.conn.connection import ChannelDescriptor
 from ..p2p.switch import Reactor
 from ..types.block import BlockID
@@ -51,39 +52,87 @@ def _batch_verify_window(vals, chain_id: str, items):
     the SAME validator set — in one device batch. `items` is a list of
     (block_id, height, commit). Returns a list of per-block Exception
     or None, mirroring VerifyCommitLight's accept/reject per block
-    (reference types/validator_set.go:720, batched across blocks)."""
-    bv = BatchVerifier()
+    (reference types/validator_set.go:720, batched across blocks).
+
+    Large all-ed25519 sets go through the expanded comb tables with
+    STRUCTURED sign bytes (one template group per block's commit,
+    types/sign_batch.py MergedSignBatch) — the same valset verifies
+    every block of the window AND every window of the catch-up, which
+    is exactly the workload the device-resident tables exist for.
+    Everything else (or any structural/device failure) falls back to
+    the general BatchVerifier with full bytes."""
     spans: list = []
     results: list = [None] * len(items)
+    lanes_all: list[int] = []
+    sigs_all: list[bytes] = []
+    per_commit: list[tuple] = []  # (commit, slots) per verifiable block
     for i, (bid, height, commit) in enumerate(items):
+        start = len(lanes_all)
         try:
             vals._check_commit_basics(bid, height, commit)
             need = 2 * vals.total_voting_power()
             tallied = 0
-            start = len(bv)
+            slots: list[int] = []
             for idx, cs in enumerate(commit.signatures):
                 if not cs.for_block():
                     continue
                 val = vals.validators[idx]
-                bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx),
-                       cs.signature)
+                lanes_all.append(idx)
+                slots.append(idx)
+                sigs_all.append(cs.signature)
                 tallied += val.voting_power
                 if 3 * tallied > need:
                     break
             if 3 * tallied <= need:
                 raise VerificationError(
                     f"insufficient voting power at height {height}")
-            spans.append((i, start, len(bv)))
+            spans.append((i, start, len(lanes_all)))
+            per_commit.append((commit, slots))
         except Exception as e:
             results[i] = e
-    if len(bv):
-        ok, verdicts = bv.verify()
-        for i, start, end in spans:
-            if not ok and not bool(verdicts[start:end].all()):
-                results[i] = VerificationError(
-                    f"invalid commit signature(s) for height "
-                    f"{items[i][1]}")
+            # roll back this block's lanes
+            del lanes_all[start:]
+            del sigs_all[start:]
+    if not lanes_all:
+        return results
+
+    verdicts = _window_lane_verdicts(
+        vals, chain_id, lanes_all, sigs_all, per_commit)
+    for i, start, end in spans:
+        if not bool(verdicts[start:end].all()):
+            results[i] = VerificationError(
+                f"invalid commit signature(s) for height "
+                f"{items[i][1]}")
     return results
+
+
+def _window_lane_verdicts(vals, chain_id, lanes_all, sigs_all, per_commit):
+    """Per-lane verdicts for a window's collected lanes.
+
+    Builds the merged structured batch (one template group per
+    block's commit) when the expanded device path will consume it and
+    the commits' values fit the vectorized layout — hostile values
+    (e.g. a timestamp past int64) get full bytes instead, WITHOUT
+    tripping the device-failure cooldown, mirroring
+    ValidatorSet._commit_msgs. The verify ladder itself (structured →
+    bytes → host, device-failure degradation, logging) is owned by
+    ValidatorSet._batch_verify_lanes — one copy for every call site."""
+    msgs = None
+    if vals._use_expanded(lanes_all):
+        from ..types.sign_batch import CommitSignBatch, MergedSignBatch
+
+        try:
+            msgs = MergedSignBatch([
+                CommitSignBatch(chain_id, c, slots)
+                for c, slots in per_commit
+            ])
+        except ValueError:
+            msgs = None
+    if msgs is None:
+        msgs = [c.vote_sign_bytes(chain_id, s)
+                for c, slots in per_commit for s in slots]
+    _, verdicts = vals._batch_verify_lanes(lanes_all, msgs, sigs_all)
+    return verdicts
 
 
 class BlockchainReactor(Reactor):

@@ -452,6 +452,11 @@ class ExpandedKeys:
     # realistic vote fits in 192 (mlen <= 175); 448 covers pathological
     # chain-id/block-id combinations up to the guard below.
     _S_WIDTHS = (192, 448)
+    # Template groups per launch, padded to a constant so every batch
+    # shares one compiled shape: a single commit uses 1-2 groups
+    # (for-block vs nil votes); a fast-sync window batches one group
+    # per block's commit (blockchain/reactor.py BATCH_WINDOW).
+    _S_GROUPS = 32
 
     def _prepare_structured(self, indices, sbatch, sigs):
         n = len(indices)
@@ -469,11 +474,13 @@ class ExpandedKeys:
         if width is None:
             raise ValueError("sign bytes too long for structured path")
         # Fixed template shapes -> one compile per (width, bucket):
-        # K padded to 2 groups, pre to 128 B, suf to 64 B (every legal
-        # vote fits; the guard keeps pathological inputs off this path).
+        # K padded to _S_GROUPS, pre to 128 B, suf to 64 B (every
+        # legal vote fits; the guard keeps pathological inputs off
+        # this path).
         k, pw = sbatch.pre.shape
         sw = sbatch.suf.shape[1]
-        if k > 2 or pw > 128 or sw > 64:
+        kp = self._S_GROUPS
+        if k > kp or pw > 128 or sw > 64:
             raise ValueError("templates too large for structured path")
         bucket = self._bucket(n)
         pad = bucket - n
@@ -487,10 +494,10 @@ class ExpandedKeys:
         fields = dict(
             sb=sig_raw,
             s_ok=tv.s_range_ok(sig_raw),
-            pre=np.pad(sbatch.pre, ((0, 2 - k), (0, 128 - pw))),
-            pre_len=padded(sbatch.pre_len, 2 - k),
-            suf=np.pad(sbatch.suf, ((0, 2 - k), (0, 64 - sw))),
-            suf_len=padded(sbatch.suf_len, 2 - k),
+            pre=np.pad(sbatch.pre, ((0, kp - k), (0, 128 - pw))),
+            pre_len=padded(sbatch.pre_len, kp - k),
+            suf=np.pad(sbatch.suf, ((0, kp - k), (0, 64 - sw))),
+            suf_len=padded(sbatch.suf_len, kp - k),
             patch=padded(sbatch.patch, pad),
             split=padded(sbatch.split, pad),
             patch_len=padded(sbatch.patch_len, pad),

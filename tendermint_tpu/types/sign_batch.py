@@ -52,8 +52,36 @@ def _varint_digits(out: np.ndarray, col: int, v: np.ndarray, ln: int):
     return col + ln
 
 
+class StructuredSignBytes:
+    """Base for structured sign-byte batches: the field layout the
+    device kernel front-end consumes (pre/suf templates + per-lane
+    group/patch/split/patch_len) plus the host-side reassembly the
+    self-check and width selection need. ValidatorSet's batch verify
+    dispatches on this type."""
+
+    def host_assemble(self, i: int) -> bytes:
+        """Reassemble lane i's sign bytes host-side with the SAME
+        boundary math the device kernel uses — the runtime self-check
+        anchor (compared against materialize()'s canonical bytes)."""
+        g = int(self.group[i])
+        a = int(self.split[i])
+        pl = int(self.patch_len[i])
+        return (bytes(self.patch[i, :a])
+                + bytes(self.pre[g, :self.pre_len[g]])
+                + bytes(self.patch[i, a:pl])
+                + bytes(self.suf[g, :self.suf_len[g]]))
+
+    def msg_lens(self) -> np.ndarray:
+        """Per-lane total sign-byte length (outer prefix included)."""
+        return (self.patch_len + self.pre_len[self.group]
+                + self.suf_len[self.group]).astype(np.int64)
+
+    def max_msg_len(self) -> int:
+        return int(self.msg_lens().max()) if len(self) else 0
+
+
 @dataclass
-class CommitSignBatch:
+class CommitSignBatch(StructuredSignBytes):
     """Sign bytes for a list of commit slots, in structured form."""
 
     chain_id: str
@@ -162,27 +190,51 @@ class CommitSignBatch:
     def __len__(self) -> int:
         return len(self.slots)
 
-    def max_msg_len(self) -> int:
-        return int(self.msg_lens().max()) if self.slots else 0
-
-    def msg_lens(self) -> np.ndarray:
-        """Per-lane total sign-byte length (outer prefix included)."""
-        return (self.patch_len + self.pre_len[self.group]
-                + self.suf_len[self.group]).astype(np.int64)
-
-    def host_assemble(self, i: int) -> bytes:
-        """Reassemble lane i's sign bytes host-side with the SAME
-        boundary math the device kernel uses — the runtime self-check
-        anchor (compared against materialize()'s canonical bytes)."""
-        g = int(self.group[i])
-        a = int(self.split[i])
-        pl = int(self.patch_len[i])
-        return (bytes(self.patch[i, :a])
-                + bytes(self.pre[g, :self.pre_len[g]])
-                + bytes(self.patch[i, a:pl])
-                + bytes(self.suf[g, :self.suf_len[g]]))
-
     def materialize(self) -> list[bytes]:
         """Full canonical sign bytes per lane (host/fallback path)."""
         return [self.commit.vote_sign_bytes(self.chain_id, s)
                 for s in self.slots]
+
+
+class MergedSignBatch(StructuredSignBytes):
+    """Several commits' CommitSignBatches as ONE structured batch —
+    the fast-sync window shape (blockchain/reactor.py): a window of
+    consecutive blocks, all signed by the same validator set, verifies
+    in a single device launch with one template group per commit.
+    Field layout is identical to CommitSignBatch (the kernel front-end
+    treats both the same); group ids are offset per sub-batch."""
+
+    def __init__(self, batches: list[CommitSignBatch]):
+        assert batches
+        self.batches = batches
+        # self-check anchor attributes (lane 0 lives in batches[0])
+        self.chain_id = batches[0].chain_id
+        self.commit = batches[0].commit
+        self.slots = batches[0].slots
+        pw = max(b.pre.shape[1] for b in batches)
+        sw = max(b.suf.shape[1] for b in batches)
+        pres, sufs, groups = [], [], []
+        off = 0
+        for b in batches:
+            k = b.pre.shape[0]
+            pres.append(np.pad(b.pre, ((0, 0), (0, pw - b.pre.shape[1]))))
+            sufs.append(np.pad(b.suf, ((0, 0), (0, sw - b.suf.shape[1]))))
+            groups.append(b.group + off)
+            off += k
+        self.pre = np.concatenate(pres, axis=0)
+        self.suf = np.concatenate(sufs, axis=0)
+        self.pre_len = np.concatenate([b.pre_len for b in batches])
+        self.suf_len = np.concatenate([b.suf_len for b in batches])
+        self.group = np.concatenate(groups)
+        self.patch = np.concatenate([b.patch for b in batches], axis=0)
+        self.split = np.concatenate([b.split for b in batches])
+        self.patch_len = np.concatenate([b.patch_len for b in batches])
+
+    def __len__(self) -> int:
+        return int(self.group.shape[0])
+
+    def materialize(self) -> list[bytes]:
+        out: list[bytes] = []
+        for b in self.batches:
+            out.extend(b.materialize())
+        return out

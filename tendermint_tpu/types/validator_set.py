@@ -221,8 +221,13 @@ class ValidatorSet:
     def _use_expanded(self, lanes: list[int]) -> bool:
         """Will _batch_verify_lanes take the expanded device path?"""
         from ..crypto import batch as _batch
+        from ..crypto.tpu import verify as tv
 
-        return (len(lanes) >= _EXPAND_MIN and _batch.device_available()
+        # Above _MAX_BATCH a single launch is off the table (the
+        # BatchVerifier fallback self-splits); e.g. a full fast-sync
+        # window at 10k validators.
+        return (_EXPAND_MIN <= len(lanes) <= tv._MAX_BATCH
+                and _batch.device_available()
                 and all(self.validators[i].pub_key.type_name == "ed25519"
                         for i in lanes))
 
@@ -268,15 +273,16 @@ class ValidatorSet:
         general BatchVerifier.
 
         msgs is either a list of sign-byte blobs or a
-        types.sign_batch.CommitSignBatch: the structured form lets the
+        types.sign_batch.StructuredSignBytes (single-commit batch or a
+        fast-sync window's merged batch): the structured form lets the
         expanded path assemble the bytes ON DEVICE (template +
         per-lane timestamp patch) instead of shipping ~190 B of
         redundant sign bytes per lane; every fallback materializes the
         identical full bytes."""
         from ..crypto import batch as _batch
-        from .sign_batch import CommitSignBatch
+        from .sign_batch import StructuredSignBytes
 
-        structured = isinstance(msgs, CommitSignBatch)
+        structured = isinstance(msgs, StructuredSignBytes)
         # structured implies _use_expanded held when the batch was
         # built (_commit_msgs) — don't repeat the O(n) key-type scan.
         if structured or self._use_expanded(lanes):

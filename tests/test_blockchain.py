@@ -177,3 +177,21 @@ def test_fastsync_catches_up_then_joins_consensus():
             await a.stop()
 
     run(go())
+
+
+@pytest.mark.slow
+def test_batch_verify_window_structured_path(monkeypatch):
+    """The expanded+structured window route (one template group per
+    block's commit, device-assembled sign bytes) returns the same
+    per-block verdicts as the fallback. _EXPAND_MIN is lowered so a
+    small valset exercises the real structured branch."""
+    import tendermint_tpu.types.validator_set as vs_mod
+
+    monkeypatch.setattr(vs_mod, "_EXPAND_MIN", 4)
+    vals, chain_id, items = _make_commit_chain(5)
+    bad = items[3][2]
+    bad.signatures[1].timestamp += 1  # device-assembled bytes differ
+    results = _batch_verify_window(vals, chain_id, items)
+    assert [r is None for r in results] == [True, True, True, False,
+                                            True]
+    assert isinstance(results[3], VerificationError)

@@ -13,6 +13,7 @@ kernel and the ed25519 reference oracle lane for lane."""
 import hashlib
 
 import numpy as np
+import pytest
 
 from tendermint_tpu.crypto import ed25519_ref as ref
 from tendermint_tpu.crypto.tpu import expanded as ex
@@ -81,6 +82,7 @@ def test_structured_matches_bytes_path_and_oracle():
     assert list(bytes_got) == list(got)
 
 
+@pytest.mark.slow
 def test_structured_all_valid_and_bucketing():
     # 130 lanes forces a padded bucket (tests pad-lane handling).
     pubs, commit, lanes, sigs, expect = _mk(n_vals=16, n_lanes=130)
@@ -118,3 +120,47 @@ def test_structured_long_chain_id():
     e = ex.ExpandedKeys(pubs)
     got = e.verify_structured(lanes, sb, sigs)
     assert bool(np.asarray(got).all())
+
+
+@pytest.mark.slow
+def test_merged_window_batch():
+    """Fast-sync window shape: several commits (distinct heights /
+    block ids), one MergedSignBatch, one structured launch — verdicts
+    match the oracle per lane, and a tampered block's lanes fail
+    without affecting neighbors. Byte-identity of the merged
+    reassembly is asserted for every lane."""
+    from tendermint_tpu.types.sign_batch import MergedSignBatch
+
+    n_vals = 24
+    seeds = [hashlib.sha256(b"sv%d" % i).digest() for i in range(n_vals)]
+    pubs = [ref.public_key_from_seed(s) for s in seeds]
+    batches, lanes_all, sigs_all, expect = [], [], [], []
+    for b in range(3):
+        bid = BlockID(hash=bytes([b] * 32),
+                      part_set_header=PartSetHeader(1, bytes(32)))
+        cs = [CommitSig(BlockIDFlag.COMMIT, bytes([i] * 20),
+                        10**18 + b * 1000 + i, b"")
+              for i in range(16)]
+        commit = Commit(height=100 + b, round=0, block_id=bid,
+                        signatures=cs)
+        slots = list(range(16))
+        for i in slots:
+            vi = (b * 16 + i) % n_vals
+            msg = commit.vote_sign_bytes(CHAIN, i)
+            sig = ref.sign(seeds[vi], msg)
+            ok = True
+            if b == 1 and i == 4:
+                sig = ref.sign(seeds[(vi + 1) % n_vals], msg)  # forged
+                ok = False
+            cs[i].signature = sig
+            lanes_all.append(vi)
+            sigs_all.append(sig)
+            expect.append(ok)
+        batches.append(CommitSignBatch(CHAIN, commit, slots))
+    merged = MergedSignBatch(batches)
+    want_bytes = merged.materialize()
+    for i in range(len(merged)):
+        assert merged.host_assemble(i) == want_bytes[i], f"lane {i}"
+    e = ex.ExpandedKeys(pubs)
+    got = e.verify_structured(lanes_all, merged, sigs_all)
+    assert list(got) == expect
