@@ -117,20 +117,17 @@ def _window_lane_verdicts(vals, chain_id, lanes_all, sigs_all, per_commit):
     ValidatorSet._commit_msgs. The verify ladder itself (structured →
     bytes → host, device-failure degradation, logging) is owned by
     ValidatorSet._batch_verify_lanes — one copy for every call site."""
-    msgs = None
-    if vals._use_expanded(lanes_all):
-        from ..types.sign_batch import CommitSignBatch, MergedSignBatch
+    from ..types.sign_batch import CommitSignBatch, MergedSignBatch
 
-        try:
-            msgs = MergedSignBatch([
-                CommitSignBatch(chain_id, c, slots)
-                for c, slots in per_commit
-            ])
-        except ValueError:
-            msgs = None
-    if msgs is None:
-        msgs = [c.vote_sign_bytes(chain_id, s)
-                for c, slots in per_commit for s in slots]
+    msgs = vals.structured_or_bytes(
+        lanes_all,
+        lambda: MergedSignBatch([
+            CommitSignBatch(chain_id, c, slots)
+            for c, slots in per_commit
+        ]),
+        lambda: [c.vote_sign_bytes(chain_id, s)
+                 for c, slots in per_commit for s in slots],
+    )
     _, verdicts = vals._batch_verify_lanes(lanes_all, msgs, sigs_all)
     return verdicts
 

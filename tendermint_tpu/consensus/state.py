@@ -787,13 +787,17 @@ class ConsensusState(Service):
     def _enqueue_vote(self, vote: Vote, peer_id: str) -> bool:
         """True if the vote was queued for batch verification (or is a
         known gossip duplicate); False -> caller takes the sync path."""
-        pk = self._resolve_vote_pubkey(vote)
-        if pk is None:
+        resolved = self._resolve_vote_pubkey(vote)
+        if resolved is None:
             return False
+        pk, vals = resolved
         vs = self._target_vote_set(vote)
         if vs is not None and vs.is_duplicate(vote):
             return True  # already tallied; don't burn a device lane
-        self._vote_buf.append((vote, peer_id, pk))
+        # vals rides along so the scheduler can route the batch
+        # through the expanded structured path (validator-index lanes
+        # against the SAME set pk was resolved from).
+        self._vote_buf.append((vote, peer_id, pk, vals))
         self._vote_pending.set()
         return True
 
@@ -808,10 +812,10 @@ class ConsensusState(Service):
         return None
 
     def _resolve_vote_pubkey(self, vote: Vote):
-        """The pubkey this vote must verify against, or None if it is
-        not addressable right now (wrong height, unknown index...) —
-        such votes take the synchronous path, which rejects them
-        cheaply before any signature work."""
+        """(pubkey, validator_set) this vote must verify against, or
+        None if it is not addressable right now (wrong height, unknown
+        index...) — such votes take the synchronous path, which
+        rejects them cheaply before any signature work."""
         rs = self.rs
         if vote.height + 1 == rs.height and vote.type == VoteType.PRECOMMIT:
             vals = (rs.last_commit.val_set
@@ -825,7 +829,7 @@ class ConsensusState(Service):
         val = vals.get_by_index(vote.validator_index)
         if val is None or val.address != vote.validator_address:
             return None
-        return val.pub_key
+        return val.pub_key, vals
 
     async def _vote_scheduler(self) -> None:
         from ..libs.metrics import consensus_metrics
@@ -866,7 +870,7 @@ class ConsensusState(Service):
 
                 def _host_verify_all(b=batch, cid=chain_id):
                     out = []
-                    for vote, _pid, pk in b:
+                    for vote, _pid, pk, _vals in b:
                         try:
                             out.append(pk.verify_signature(
                                 vote.sign_bytes(cid), vote.signature))
@@ -882,7 +886,7 @@ class ConsensusState(Service):
                         "degraded host verify failed; dropping batch")
                     continue
                 per_peer: dict[str, list[int]] = {}
-                for (vote, peer_id, _), ok in zip(batch, verdicts):
+                for (vote, peer_id, _, _), ok in zip(batch, verdicts):
                     if peer_id:
                         counts = per_peer.setdefault(peer_id, [0, 0])
                         counts[0 if ok else 1] += 1
@@ -916,23 +920,56 @@ class ConsensusState(Service):
                             self.logger.exception(
                                 "trust feedback failed for %r", peer_id)
 
+    def _batch_verdicts(self, batch, chain_id):
+        """Per-lane verdicts for a vote micro-batch (runs in the
+        executor, off the event loop).
+
+        Lanes group by the validator set each vote resolved against
+        (current height vs last-commit precommits); each group routes
+        through ValidatorSet._batch_verify_lanes — the same
+        structured->bytes->host ladder every commit-verify call site
+        uses, so big all-ed25519 bursts hit the expanded comb tables
+        with device-assembled sign bytes (VoteSignBatch: one template
+        group per distinct (type, height, round, block_id)) instead of
+        shipping full sign-byte rows through the general kernel."""
+        import numpy as _np
+
+        from ..types.sign_batch import VoteSignBatch
+
+        verdicts = _np.zeros(len(batch), bool)
+        groups: dict[int, tuple] = {}
+        for j, (vote, _peer, _pk, vals) in enumerate(batch):
+            entry = groups.get(id(vals))
+            if entry is None:
+                groups[id(vals)] = entry = (vals, [])
+            entry[1].append(j)
+        for vals, idxs in groups.values():
+            votes = [batch[j][0] for j in idxs]
+            lanes = [v.validator_index for v in votes]
+            sigs = [v.signature for v in votes]
+            msgs = vals.structured_or_bytes(
+                lanes,
+                lambda: VoteSignBatch(chain_id, votes),
+                lambda: [v.sign_bytes(chain_id) for v in votes],
+            )
+            _, group_verdicts = vals._batch_verify_lanes(
+                lanes, msgs, sigs)
+            verdicts[_np.asarray(idxs)] = _np.asarray(group_verdicts)
+        return verdicts
+
     async def _verify_and_commit_batch(self, batch, met, loop) -> None:
         met.vote_batch_size.observe(len(batch))
         chain_id = self.state.chain_id
-        from ..crypto.batch import BatchVerifier
-
-        bv = BatchVerifier()
-        for vote, _, pk in batch:
-            bv.add(pk, vote.sign_bytes(chain_id), vote.signature)
         if len(batch) > 1:
             # Device (or host-oracle) verify OFF the event loop:
             # gossip, RPC and timeouts keep running during a
-            # 10k-lane commit verify.
-            _, verdicts = await loop.run_in_executor(None, bv.verify)
+            # 10k-lane burst.
+            verdicts = await loop.run_in_executor(
+                None, self._batch_verdicts, batch, chain_id)
         else:
-            _, verdicts = bv.verify()
+            verdicts = self._batch_verdicts(batch, chain_id)
         per_peer: dict[str, list[int]] = {}  # peer -> [good, bad]
-        for (vote, peer_id, _), ok in zip(batch, verdicts):
+        for (vote, peer_id, _, _), ok in zip(batch, verdicts):
             if peer_id:
                 counts = per_peer.setdefault(peer_id, [0, 0])
                 counts[0 if ok else 1] += 1

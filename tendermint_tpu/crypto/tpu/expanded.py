@@ -455,7 +455,10 @@ class ExpandedKeys:
     # Template groups per launch, padded to a constant so every batch
     # shares one compiled shape: a single commit uses 1-2 groups
     # (for-block vs nil votes); a fast-sync window batches one group
-    # per block's commit (blockchain/reactor.py BATCH_WINDOW).
+    # per block's commit (BATCH_WINDOW); a vote micro-batch one per
+    # distinct (type, height, round, block_id). Builders enforce the
+    # same cap (types/sign_batch.py MAX_GROUPS) at construction so
+    # overflow falls back to full bytes at the call site.
     _S_GROUPS = 32
 
     def _prepare_structured(self, indices, sbatch, sigs):
@@ -463,10 +466,10 @@ class ExpandedKeys:
         assert len(sbatch) == n
         idx = self._check_idx(indices, len(sigs))
         # Cheap host self-check: the structured reassembly of lane 0
-        # must equal the canonical sign bytes. Catches template-math
-        # drift at the call site instead of verifying wrong bytes.
-        if sbatch.host_assemble(0) != sbatch.commit.vote_sign_bytes(
-                sbatch.chain_id, sbatch.slots[0]):
+        # must equal the independently-computed canonical sign bytes.
+        # Catches template-math drift at the call site instead of
+        # verifying wrong bytes.
+        if sbatch.host_assemble(0) != sbatch.anchor_bytes():
             raise ValueError("structured sign-bytes self-check failed")
         max_len = sbatch.max_msg_len()
         width = next((w for w in self._S_WIDTHS if max_len <= w - 17),

@@ -244,25 +244,35 @@ class ValidatorSet:
         return expanded.warm_async(
             [v.pub_key.bytes() for v in self.validators])
 
-    def _commit_msgs(self, chain_id: str, commit, slots: list[int],
-                     lanes: list[int]):
-        """Sign bytes for the given commit slots: structured form
-        (types/sign_batch.py — the device assembles the bytes, so the
-        launch skips shipping full per-lane rows) when the expanded
-        device path will consume it, a plain materialized list
-        otherwise (small sets / host fallback would just throw the
-        structure away) or when the commit's values don't fit the
-        vectorized layout (e.g. a hostile timestamp past int64)."""
-        if not slots:
-            return []
+    def structured_or_bytes(self, lanes: list[int], build, materialize):
+        """THE structured-vs-full-bytes policy, one copy for every
+        call site (commit verify, fast-sync windows, vote scheduler):
+        build() -> a types.sign_batch.StructuredSignBytes when the
+        expanded device path will consume it; ValueError from build
+        (hostile timestamps, too many template groups, oversized sign
+        bytes) means the input doesn't fit the vectorized layout —
+        fall back to materialize()'s full bytes SILENTLY, because
+        that's an input property, not a bug."""
         if self._use_expanded(lanes):
-            from .sign_batch import CommitSignBatch
-
             try:
-                return CommitSignBatch(chain_id, commit, slots)
+                return build()
             except ValueError:
                 pass
-        return [commit.vote_sign_bytes(chain_id, s) for s in slots]
+        return materialize()
+
+    def _commit_msgs(self, chain_id: str, commit, slots: list[int],
+                     lanes: list[int]):
+        """Sign bytes for the given commit slots: structured when the
+        device path will consume it, materialized otherwise."""
+        if not slots:
+            return []
+        from .sign_batch import CommitSignBatch
+
+        return self.structured_or_bytes(
+            lanes,
+            lambda: CommitSignBatch(chain_id, commit, slots),
+            lambda: [commit.vote_sign_bytes(chain_id, s) for s in slots],
+        )
 
     def _batch_verify_lanes(self, lanes: list[int], msgs,
                             sigs: list[bytes]):

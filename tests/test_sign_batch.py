@@ -115,3 +115,50 @@ def test_subset_of_slots():
     commit = _mk_commit("subset", 5, 1, EDGE_TS)
     slots = [1, 3, 8]
     _assert_batch_matches("subset", commit, slots)
+
+
+def test_vote_sign_batch_byte_identical():
+    """VoteSignBatch (live gossip micro-batch shape): mixed types,
+    heights, rounds, nil/non-nil block ids — every lane's structured
+    reassembly must equal Vote.sign_bytes exactly."""
+    from tendermint_tpu.types.sign_batch import VoteSignBatch
+    from tendermint_tpu.types.vote import Vote, VoteType
+
+    bid = BlockID(hash=bytes(range(32)),
+                  part_set_header=PartSetHeader(2, bytes(32)))
+    votes = []
+    for i, ts in enumerate(EDGE_TS):
+        votes.append(Vote(
+            type=(VoteType.PREVOTE if i % 2 else VoteType.PRECOMMIT),
+            height=50 + (i % 3),
+            round=i % 2,
+            block_id=(None if i % 5 == 4 else bid),
+            timestamp=ts,
+            validator_address=bytes([i] * 20),
+            validator_index=i,
+        ))
+    sb = VoteSignBatch("vote-chain", votes)
+    want = sb.materialize()
+    for i in range(len(votes)):
+        assert sb.host_assemble(i) == want[i], f"lane {i}"
+    assert sb.anchor_bytes() == want[0]
+    assert [int(x) for x in sb.msg_lens()] == [len(w) for w in want]
+    # distinct (type, height, round, block_id) combos -> groups
+    assert len(set(sb.group.tolist())) > 2
+
+
+def test_vote_sign_batch_group_cap():
+    """>MAX_GROUPS distinct vote keys raise at CONSTRUCTION so call
+    sites fall back to full bytes silently (a peer fabricating many
+    block_ids must not reach the verify-time template-bug signal)."""
+    import pytest
+
+    from tendermint_tpu.types.sign_batch import MAX_GROUPS, VoteSignBatch
+    from tendermint_tpu.types.vote import Vote, VoteType
+
+    votes = [Vote(type=VoteType.PREVOTE, height=1, round=r,
+                  block_id=None, timestamp=1 + r,
+                  validator_address=bytes(20), validator_index=0)
+             for r in range(MAX_GROUPS + 1)]
+    with pytest.raises(ValueError):
+        VoteSignBatch("cap", votes)

@@ -164,3 +164,49 @@ def test_merged_window_batch():
     e = ex.ExpandedKeys(pubs)
     got = e.verify_structured(lanes_all, merged, sigs_all)
     assert list(got) == expect
+
+
+def test_vote_batch_structured_verdicts(monkeypatch):
+    """Vote micro-batch through ValidatorSet._batch_verify_lanes with
+    a VoteSignBatch (the scheduler's structured route): verdicts match
+    per-lane expectations incl. a tampered-timestamp vote and a
+    cross-round mix. Uses the same (valset=24, bucket=64) shapes as
+    the tests above, so no fresh kernel compiles."""
+    import tendermint_tpu.types.validator_set as vs_mod
+    from tendermint_tpu.crypto.ed25519 import Ed25519PubKey
+    from tendermint_tpu.types.sign_batch import VoteSignBatch
+    from tendermint_tpu.types.validator import Validator
+    from tendermint_tpu.types.validator_set import ValidatorSet
+    from tendermint_tpu.types.vote import Vote, VoteType
+
+    monkeypatch.setattr(vs_mod, "_EXPAND_MIN", 4)
+    n_vals = 24
+    seeds = [hashlib.sha256(b"sv%d" % i).digest() for i in range(n_vals)]
+    pubs = [Ed25519PubKey(ref.public_key_from_seed(s))
+            for s in seeds]
+    by_addr = {pubs[i].address(): seeds[i] for i in range(n_vals)}
+    vals = ValidatorSet([Validator(address=p.address(), pub_key=p,
+                                   voting_power=5) for p in pubs])
+    bid = BlockID(hash=bytes(range(32)),
+                  part_set_header=PartSetHeader(1, bytes(32)))
+    votes, sigs, lanes, expect = [], [], [], []
+    for i, v in enumerate(vals.validators):
+        for r in (0, 1):  # two rounds in one micro-batch
+            vote = Vote(type=VoteType.PREVOTE, height=9, round=r,
+                        block_id=bid, timestamp=10**18 + i * 7 + r,
+                        validator_address=v.address,
+                        validator_index=i)
+            sig = ref.sign(by_addr[v.address],
+                           vote.sign_bytes(CHAIN))
+            ok = True
+            if i == 3 and r == 1:
+                vote.timestamp += 1  # signed bytes != carried ts
+                ok = False
+            vote.signature = sig
+            votes.append(vote)
+            sigs.append(sig)
+            lanes.append(i)
+            expect.append(ok)
+    sb = VoteSignBatch(CHAIN, votes)
+    all_ok, verdicts = vals._batch_verify_lanes(lanes, sb, sigs)
+    assert list(verdicts) == expect and not all_ok
