@@ -550,6 +550,25 @@ _CACHE_LOCK = threading.Lock()
 _BUILDS: dict[bytes, threading.Event] = {}
 
 
+@functools.cache
+def max_keys() -> int:
+    """Largest valset the expanded tables serve on this backend.
+
+    Accelerators: HBM budget — ~318 KB/key, 3.3 GB at 10k keys on a
+    16 GB chip; beyond ~40k switch to key-range sharding (not yet
+    needed: MaxVotesCount caps commits at 10k validators). CPU
+    backend (tests / e2e nets / degraded nodes): the tables replicate
+    per virtual mesh device inside ONE host RAM and there is no
+    host->device wire to save, so big builds are pure cost — cap at
+    one build chunk. Callers fall back to the general batch path
+    above the cap (ValidatorSet._use_expanded)."""
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        return ExpandedKeys.BUILD_CHUNK
+    return 40_000
+
+
 def get_expanded(pubkeys: list[bytes]) -> ExpandedKeys:
     key = hashlib.sha256(b"".join(pubkeys)).digest()
     while True:

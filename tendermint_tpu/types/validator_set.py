@@ -225,9 +225,24 @@ class ValidatorSet:
 
         # Above _MAX_BATCH a single launch is off the table (the
         # BatchVerifier fallback self-splits); e.g. a full fast-sync
-        # window at 10k validators.
-        return (_EXPAND_MIN <= len(lanes) <= tv._MAX_BATCH
-                and _batch.device_available()
+        # window at 10k validators. The valset-size cap is
+        # backend-dependent (expanded.max_keys: HBM budget on chips,
+        # one build chunk on the CPU backend where tables buy nothing).
+        if not (_EXPAND_MIN <= len(lanes) <= tv._MAX_BATCH
+                and _batch.device_available()):
+            return False
+        try:
+            from ..crypto.tpu import expanded
+
+            cap = expanded.max_keys()
+        except Exception:
+            # max_keys inits the JAX backend; a broken device runtime
+            # must degrade to the host path (with the usual cooldown),
+            # not crash commit verification.
+            _batch.mark_device_failed()
+            _batch.logger.exception("backend probe failed; host path")
+            return False
+        return (len(self.validators) <= cap
                 and all(self.validators[i].pub_key.type_name == "ed25519"
                         for i in lanes))
 

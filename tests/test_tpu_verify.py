@@ -266,3 +266,42 @@ def test_warm_device_tables_gating():
         for i in range(4)
     ])
     assert small.warm_device_tables() is None  # below _EXPAND_MIN
+
+
+def test_expanded_backend_cap_gates_use_expanded(monkeypatch):
+    """Valsets above max_keys() (backend-dependent: one build chunk on
+    CPU, HBM budget on chips) must route to the general batch path;
+    at/below the cap the expanded path stays on."""
+    import hashlib
+
+    import tendermint_tpu.crypto.tpu.expanded as exmod
+    import tendermint_tpu.types.validator_set as vs_mod
+    from tendermint_tpu.crypto import ed25519_ref as ref
+    from tendermint_tpu.crypto.ed25519 import Ed25519PubKey
+    from tendermint_tpu.types.validator import Validator
+    from tendermint_tpu.types.validator_set import ValidatorSet
+
+    monkeypatch.setattr(vs_mod, "_EXPAND_MIN", 2)
+    vals = ValidatorSet([
+        Validator(address=(p := Ed25519PubKey(ref.public_key_from_seed(
+            hashlib.sha256(b"cap%d" % i).digest()))).address(),
+            pub_key=p, voting_power=1)
+        for i in range(6)
+    ])
+    lanes = list(range(6))
+    monkeypatch.setattr(exmod, "max_keys", lambda: 4)
+    assert not vals._use_expanded(lanes)   # 6 validators > cap 4
+    monkeypatch.setattr(exmod, "max_keys", lambda: 6)
+    assert vals._use_expanded(lanes)       # at the cap: expanded on
+
+    # a broken backend degrades (cooldown), never raises
+    def boom():
+        raise RuntimeError("backend init failed")
+
+    import tendermint_tpu.crypto.batch as _batch
+
+    monkeypatch.setattr(exmod, "max_keys", boom)
+    monkeypatch.setattr(_batch, "_device_down_until", 0.0)
+    assert not vals._use_expanded(lanes)
+    assert not _batch.device_available()   # cooldown engaged
+    monkeypatch.setattr(_batch, "_device_down_until", 0.0)
