@@ -2,8 +2,12 @@
 
 The device-side half of the `crypto.backend=tpu` capability: wide-batch
 ZIP-215 ed25519 verification. Layout convention throughout: field
-elements are int32 arrays of shape (22, N) — 22 limbs x 12 bits with the
-batch on the trailing axis so it lands on TPU vector lanes; the limb
-axis rides sublanes. All arithmetic is exact int32 with proven bounds
-(see field.py docstrings); no floating point touches consensus results.
+elements are (NLIMB, N) limb arrays with the batch on the trailing axis
+so it lands on TPU vector lanes; the limb axis rides sublanes. Two
+interchangeable representations (fieldsel.py): the default i32 rep
+(22 x 12-bit non-negative limbs, exact int32 with proven bounds) and
+an f32 rep (32 x 8-bit signed limbs, every value exact under the
+24-bit mantissa; TM_TPU_FIELD=f32) kept as a differential oracle after
+losing the round-4 silicon A/B (see fieldsel.py). No inexact floating
+point touches consensus results in either rep.
 """

@@ -1,6 +1,7 @@
 """Batched edwards25519 point arithmetic on TPU limb vectors.
 
-Points are 4-tuples (X, Y, Z, T) of (22, N) limb arrays — extended
+Points are 4-tuples (X, Y, Z, T) of (NLIMB, N) limb arrays (the
+active field representation — fieldsel.py) — extended
 homogeneous coordinates with x = X/Z, y = Y/Z, T = XY/Z. The addition
 formulas are the *complete* unified formulas for twisted Edwards curves
 with a = -1 (add-2008-hwcd-3 / dbl-2008-hwcd): valid for ALL inputs
@@ -19,7 +20,7 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
-from . import field as fe
+from .fieldsel import F as fe
 
 
 class Point(NamedTuple):
@@ -94,7 +95,7 @@ def _d2(n: int):
 
         limbs = np.asarray(fe.to_limbs(fe.D2))[:, None]
         _consts[key] = np.ascontiguousarray(
-            np.broadcast_to(limbs, (22, n))
+            np.broadcast_to(limbs, (fe.NLIMB, n))
         )
     return _consts[key]
 
@@ -102,7 +103,7 @@ def _d2(n: int):
 def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray) -> tuple[Point, jnp.ndarray]:
     """ZIP-215 decompression of a batch of encodings.
 
-    y_limbs: (22, N) — the low 255 bits of the encoding (any value
+    y_limbs: (NLIMB, N) — the low 255 bits of the encoding (any value
     < 2^255; values >= p are implicitly reduced by field arithmetic).
     sign: (N,) int32 in {0, 1} — the top bit.
 
@@ -135,7 +136,7 @@ def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray) -> tuple[Point, jnp.ndar
 
 
 def select(table: jnp.ndarray, digit: jnp.ndarray) -> Point:
-    """Per-lane table lookup. table: (W, 4, 22, N); digit: (N,) in [0, W).
+    """Per-lane table lookup. table: (W, 4, NLIMB, N); digit: (N,) in [0, W).
 
     Computed as a masked sum over the W entries — no gather, pure VPU.
     """
@@ -146,16 +147,16 @@ def select(table: jnp.ndarray, digit: jnp.ndarray) -> Point:
 
 
 def select_const(table: jnp.ndarray, digit: jnp.ndarray) -> tuple:
-    """Shared-table lookup. table: (W, 3, 22) consts (x, y, t with Z=1);
+    """Shared-table lookup. table: (W, 3, NLIMB) consts (x, y, t with Z=1);
     digit: (N,). Contraction over W is a small matmul — MXU-friendly."""
     w = table.shape[0]
-    oh = (digit[None, :] == jnp.arange(w, dtype=jnp.int32)[:, None]).astype(jnp.int32)
-    sel = jnp.einsum("wn,wcl->cln", oh, table)  # (3, 22, N)
+    oh = (digit[None, :] == jnp.arange(w, dtype=jnp.int32)[:, None]).astype(table.dtype)
+    sel = jnp.einsum("wn,wcl->cln", oh, table)  # (3, NLIMB, N)
     return sel[0], sel[1], sel[2]
 
 
 def build_window_table(p: Point, width: int = 16) -> jnp.ndarray:
-    """[0..width-1] * P as a (width, 4, 22, N) array (entry 0 = identity)."""
+    """[0..width-1] * P as a (width, 4, NLIMB, N) array (entry 0 = identity)."""
     n = p.x.shape[-1]
     entries = [identity(n), p]
     for _ in range(width - 2):

@@ -50,7 +50,8 @@ from . import verify as tv
 
 _WINDOWS = 69  # scalar.DIGITS_K: folded challenge < 2^271
 _ENTRIES = 9   # signed digits: |d| in 0..8
-_ROW = 128     # padded row: 4 coords * 22 limbs = 88 ints + 40 pad
+_ROW = 128     # table row width: 4 coords * NLIMB limbs, padded.
+# i32 rep: 88 ints + 40 pad; f32 rep: 4 * 32 = 128 floats exactly.
 # Expansion pays off only when the same set verifies repeatedly and the
 # batch is big enough for the device path; below this many keys the
 # general kernel is used instead.
@@ -63,16 +64,19 @@ def _builder():
     import jax.numpy as jnp
 
     from . import edwards as ed
-    from . import scalar as sc
+    from .fieldsel import F as fe
+
+    payload = 4 * fe.NLIMB
+    assert payload <= _ROW
 
     @jax.jit
     def build(ab):
-        """(V, 32) uint8 pubkeys -> ((V*69*9, 128) int32 rows, (V,) ok)."""
+        """(V, 32) uint8 pubkeys -> ((V*69*9, 128) limb rows, (V,) ok)."""
         v = ab.shape[0]
         a_bytes = ab.astype(jnp.int32).T  # (32, V)
         a_sign = a_bytes[31] >> 7
         a_top = (a_bytes[31] & 0x7F)[None]
-        a_y = sc.bytes_to_limbs(jnp.concatenate([a_bytes[:31], a_top]), 22)
+        a_y = fe.limbs_from_bytes(jnp.concatenate([a_bytes[:31], a_top]))
         pt, ok = ed.decompress(a_y, a_sign)
         neg_a = ed.neg(pt)
 
@@ -82,17 +86,20 @@ def _builder():
                 entries.append(ed.add(entries[-1], base))
             row = jnp.stack(
                 [jnp.stack(list(e), axis=0) for e in entries], axis=0
-            )  # (9, 4, 22, V)
+            )  # (9, 4, NLIMB, V)
             nxt = ed.double(ed.double(ed.double(ed.double(base))))
             return nxt, row
 
         _, rows = jax.lax.scan(step, neg_a, None, length=_WINDOWS)
-        # (69, 9, 4, 22, V): merge coord dims while V is still the minor
-        # axis (clean tiling), pad the 88-int payload to a 128-int row,
-        # then rotate V major. Every stored intermediate keeps a
-        # >=128-wide minor dim so nothing hits the (8,128) tile blowup.
-        rows = rows.reshape(_WINDOWS, _ENTRIES, 4 * 22, v)
-        rows = jnp.pad(rows, ((0, 0), (0, 0), (0, _ROW - 4 * 22), (0, 0)))
+        # (69, 9, 4, NLIMB, V): merge coord dims while V is still the
+        # minor axis (clean tiling), pad the payload to a 128-wide row
+        # (f32 rep: 4*32 = 128, zero pad), then rotate V major. Every
+        # stored intermediate keeps a >=128-wide minor dim so nothing
+        # hits the (8,128) tile blowup.
+        rows = rows.reshape(_WINDOWS, _ENTRIES, payload, v)
+        if payload != _ROW:
+            rows = jnp.pad(
+                rows, ((0, 0), (0, 0), (0, _ROW - payload), (0, 0)))
         rows = jnp.transpose(rows, (3, 0, 1, 2))  # (V, 69, 9, 128)
         return rows.reshape(v * _WINDOWS * _ENTRIES, _ROW), ok
 
@@ -101,11 +108,12 @@ def _builder():
 
 # Windows processed per fori_loop iteration (69 must divide evenly:
 # 1, 3, or 23). >1 unrolls the loop body, giving XLA ILP across
-# windows at the cost of a bigger program — an A/B knob for
-# tools/sweep_thresholds.py on the real chip (task: the 69-iteration
-# serial loop is the latency suspect). Default 1 = round-2 behavior.
+# windows at the cost of a bigger program. Default 3 from the round-4
+# silicon A/B at 1,024 lanes: device exec 13.8 ms (wpi=1) -> 8.33 ms
+# (wpi=3) -> 10.76 ms (wpi=23) — the mid unroll cuts the per-iteration
+# fixed cost without blowing up the program.
 WINDOWS_PER_ITER = int(__import__("os").environ.get(
-    "TM_TPU_WINDOWS_PER_ITER", "1"))
+    "TM_TPU_WINDOWS_PER_ITER", "3"))
 
 
 @functools.cache
@@ -117,11 +125,12 @@ def _xcore(wpi: int = WINDOWS_PER_ITER):
     import jax.numpy as jnp
 
     from . import edwards as ed
-    from . import field as fe
     from . import scalar as sc
     from . import sha512 as sh
+    from .fieldsel import F as fe
 
     assert _WINDOWS % wpi == 0, "windows-per-iter must divide 69"
+    L = fe.NLIMB  # payload layout: 4 coords of L limbs per table row
 
     def core(idx, akeys, sb, msg, nblocks, s_ok, key_ok, atab, btab):
         n = idx.shape[0]
@@ -149,7 +158,8 @@ def _xcore(wpi: int = WINDOWS_PER_ITER):
         # R decompression (per-signature; the only uncacheable curve work).
         r_sign = sig_bytes[31] >> 7
         r_top = (sig_bytes[31] & 0x7F)[None]
-        r_y = sc.bytes_to_limbs(jnp.concatenate([sig_bytes[:31], r_top]), 22)
+        r_y = fe.limbs_from_bytes(
+            jnp.concatenate([sig_bytes[:31], r_top]))
         R, r_ok = ed.decompress(r_y, r_sign)
         neg_r = ed.neg(R)
 
@@ -162,20 +172,20 @@ def _xcore(wpi: int = WINDOWS_PER_ITER):
             + dmag
         )  # (69, N)
         sel = jnp.take(atab, flat.reshape(-1), axis=0)  # (69*N, 128)
-        # ONE transpose to the kernel's limb-major layout; slicing the
-        # 40 pad ints fuses into it. Doing this per window instead
+        # ONE transpose to the kernel's limb-major layout; slicing any
+        # pad ints fuses into it. Doing this per window instead
         # (69 small transposes out of a lane-major buffer) costs ~60 ms
         # of device time at 16k lanes — measured, not hypothetical.
         sel = jnp.transpose(sel.reshape(_WINDOWS, n, _ROW), (0, 2, 1))
-        sel = sel[:, : 4 * 22, :]  # (69, 88, N)
+        sel = sel[:, : 4 * L, :]  # (69, 4L, N)
 
         def one_window(w, acc_a, acc_b):
             e = jax.lax.dynamic_index_in_dim(sel, w, 0, keepdims=False)
             neg = jax.lax.dynamic_index_in_dim(dsign, w, 0, keepdims=False)
             # -(x, y, z, t) = (-x, y, z, -t), applied per digit sign.
-            qx = jnp.where(neg[None], fe.neg(e[:22]), e[:22])
-            qt = jnp.where(neg[None], fe.neg(e[66:]), e[66:])
-            acc_a = ed.add(acc_a, ed.Point(qx, e[22:44], e[44:66], qt))
+            qx = jnp.where(neg[None], fe.neg(e[:L]), e[:L])
+            qt = jnp.where(neg[None], fe.neg(e[3 * L:]), e[3 * L:])
+            acc_a = ed.add(acc_a, ed.Point(qx, e[L:2 * L], e[2 * L:3 * L], qt))
             ds = jax.lax.dynamic_index_in_dim(digs, w, 0, keepdims=False)
             bw = jax.lax.dynamic_index_in_dim(btab, w, 0, keepdims=False)
             bx, by, bt = ed.select_const(bw, ds)

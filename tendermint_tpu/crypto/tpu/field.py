@@ -38,6 +38,7 @@ BITS = 12
 MASK = (1 << BITS) - 1
 # 2^(12*22) = 2^264 ≡ 19 * 2^9 (mod p)
 FOLD = 19 << 9
+SIGNED = False  # limbs are kept non-negative (see sub bias below)
 
 
 def to_limbs(x: int) -> np.ndarray:
@@ -64,6 +65,14 @@ def from_limbs(limbs):
 def splat(x: int, n: int) -> jnp.ndarray:
     """Broadcast a constant element across an N-batch."""
     return jnp.tile(jnp.asarray(to_limbs(x))[:, None], (1, n))
+
+
+def limbs_from_bytes(byte_rows) -> jnp.ndarray:
+    """(32, N) int32 byte rows (LE, top byte pre-masked) -> (22, N)
+    12-bit limbs (static shift/mask rows; shared with scalar.py)."""
+    from . import scalar as sc
+
+    return sc.bytes_to_limbs(byte_rows, NLIMB)
 
 
 # Bias for subtraction: 1024*p in a redundant representation whose every

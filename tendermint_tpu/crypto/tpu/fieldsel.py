@@ -1,0 +1,38 @@
+"""Field-representation selector for the TPU kernel stack.
+
+Two interchangeable GF(2^255-19) implementations exist:
+
+  * `field` — 22 x 12-bit non-negative limbs in int32. DEFAULT.
+  * `field_f32` — 32 x 8-bit SIGNED limbs in float32, every value
+    exact under 2^24 (TM_TPU_FIELD=f32).
+
+Both are golden-tested against Python big-int ground truth and produce
+bit-identical accept/reject decisions; the selector only changes which
+arithmetic the kernels trace. Chosen once at import — the kernel
+caches (jit, comb tables, expanded valset tables) are keyed on module
+identity, so flipping mid-process is not supported.
+
+Why i32 is the default — a measured negative result (v5e, round 4):
+the hypothesis was that the VPU's slow emulated int32 multiply
+(~0.59 T mul-add/s measured standalone) made the field kernel
+multiply-bound, and that f32 limbs would win despite needing 32^2
+products per multiply vs i32's 22^2 (the 24-bit-mantissa exactness
+bound forces narrower limbs). On silicon at 10,240 lanes the f32
+kernel ran ~53 ms device-exec vs i32's ~40 ms: the 2.1x op-count
+increase outweighed the per-op speedup inside the fused kernel.
+The f32 module stays as a differential-testing oracle and because
+the tradeoff may flip on other TPU generations (docs/PERF_NOTES.md).
+"""
+
+from __future__ import annotations
+
+import os
+
+_CHOICE = os.environ.get("TM_TPU_FIELD", "i32")
+if _CHOICE == "f32":
+    from . import field_f32 as F  # noqa: F401
+elif _CHOICE == "i32":
+    from . import field as F  # noqa: F401
+else:  # fail loudly: a typo here must not silently test the wrong rep
+    raise ValueError(
+        f"TM_TPU_FIELD={_CHOICE!r}: expected 'i32' or 'f32'")

@@ -1,7 +1,7 @@
 """Batched ristretto255 (RFC 9496) on device: decode + equality.
 
-Built on the same 22-limb field arithmetic as the ed25519 kernel
-(field.py); decode costs one sqrt-ratio exponentiation per lane — the
+Built on the same limb field arithmetic as the ed25519 kernel
+(fieldsel.py); decode costs one sqrt-ratio exponentiation per lane — the
 same pow_2_252_m3 chain edwards.decompress uses (2^252-3 == (p-5)/8).
 Encoding never runs on device: sr25519 verification only needs
 "encode(V) == R_bytes", which over the quotient group is ristretto
@@ -19,7 +19,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from . import edwards as ed
-from . import field as fe
+from .fieldsel import F as fe
 
 
 def _abs(x: jnp.ndarray) -> jnp.ndarray:
@@ -29,7 +29,7 @@ def _abs(x: jnp.ndarray) -> jnp.ndarray:
 
 def sqrt_ratio_m1(u: jnp.ndarray, v: jnp.ndarray,
                   n: int) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """RFC 9496 §4.2 SQRT_RATIO_M1 over (22, N) limb vectors.
+    """RFC 9496 §4.2 SQRT_RATIO_M1 over (NLIMB, N) limb vectors.
 
     Returns (was_square (N,) bool, non-negative root r (22, N))."""
     v3 = fe.mul(fe.sqr(v), v)
@@ -46,7 +46,7 @@ def sqrt_ratio_m1(u: jnp.ndarray, v: jnp.ndarray,
 
 
 def decode(s: jnp.ndarray, pre_ok: jnp.ndarray) -> tuple[ed.Point, jnp.ndarray]:
-    """RFC 9496 §4.3.1 DECODE of (22, N) limb-unpacked encodings.
+    """RFC 9496 §4.3.1 DECODE of (NLIMB, N) limb-unpacked encodings.
 
     `pre_ok` carries the host byte checks (canonical < p, even). Lanes
     that fail any check come back as the identity with ok=False so

@@ -53,13 +53,13 @@ def _kernel():
 
     from . import edwards as ed
     from . import ristretto as rs
-    from . import scalar as sc
+    from .fieldsel import F as fe
 
     @jax.jit
     def kernel(ab, rb, kdig, sdig, a_pre, r_pre, s_ok, btab):
         n = ab.shape[0]
-        a_limbs = sc.bytes_to_limbs(ab.astype(jnp.int32).T, 22)
-        r_limbs = sc.bytes_to_limbs(rb.astype(jnp.int32).T, 22)
+        a_limbs = fe.limbs_from_bytes(ab.astype(jnp.int32).T)
+        r_limbs = fe.limbs_from_bytes(rb.astype(jnp.int32).T)
         # Fused 2N ristretto decode (one sqrt-ratio dispatch, like the
         # ed25519 kernel's fused A/R decompression).
         limbs2 = jnp.concatenate([a_limbs, r_limbs], axis=1)

@@ -58,16 +58,18 @@ _L_WORDS = np.frombuffer(_L.to_bytes(32, "little"), np.uint64)
 
 @functools.cache
 def b_comb_tables() -> np.ndarray:
-    """(69, 16, 3, 22) int32: affine (x, y, x*y) of j * 16^w * B.
+    """(69, 16, 3, NLIMB): affine (x, y, x*y) of j * 16^w * B in the
+    active field representation's limb layout/dtype (fieldsel.py).
 
     Entry (w, 0) is the identity (0, 1, 0). Windows 64..68 exist only
     to keep the fused 69-iteration loop uniform — S has 64 nibbles, the
     padded digit rows select entry 0, so those windows are all-identity.
     Built once host-side with the pure-Python oracle (~1.2k point ops).
     """
-    from . import field as fe
+    from .fieldsel import F as fe
 
-    tab = np.zeros((_DIGITS_K, 16, 3, 22), np.int32)
+    tab = np.zeros((_DIGITS_K, 16, 3, fe.NLIMB),
+                   np.asarray(fe.to_limbs(0)).dtype)
     base = ref._B_PT
     for w in range(64):
         acc = ref.IDENTITY
@@ -89,17 +91,23 @@ def b_comb_tables() -> np.ndarray:
 
 
 def _bytes32_to_limbs(arr: np.ndarray) -> np.ndarray:
-    """(N, 32) uint8 (top bit already cleared) -> (22, N) int32 limbs.
+    """(N, 32) uint8 (top bit already cleared) -> (NLIMB, N) limbs in
+    the active field representation (fieldsel.py).
 
-    Host-side helper (tests and table precomputation); the hot path
-    unpacks on device via scalar.bytes_to_limbs.
+    Host-side helper (tests and table precomputation), implemented in
+    pure numpy INDEPENDENTLY of the device unpack (fe.limbs_from_bytes)
+    so tests feeding it into kernels cross-check the device path.
     """
+    from .fieldsel import F as fe
+
     bits = np.unpackbits(arr, axis=1, bitorder="little")  # (N, 256)
-    bits = np.pad(bits, ((0, 0), (0, 264 - 256)))
-    bits = bits.reshape(arr.shape[0], 22, 12)
-    weights = (1 << np.arange(12, dtype=np.int32))
-    limbs = (bits.astype(np.int32) * weights).sum(axis=2)  # (N, 22)
-    return np.ascontiguousarray(limbs.T)
+    width = fe.BITS * fe.NLIMB
+    bits = np.pad(bits, ((0, 0), (0, width - 256)))
+    bits = bits.reshape(arr.shape[0], fe.NLIMB, fe.BITS)
+    weights = (1 << np.arange(fe.BITS, dtype=np.int64))
+    limbs = (bits.astype(np.int64) * weights).sum(axis=2)  # (N, NLIMB)
+    return np.ascontiguousarray(
+        limbs.T.astype(np.asarray(fe.to_limbs(0)).dtype))
 
 
 def pack_batch(pubs, msgs, sigs) -> dict[str, np.ndarray]:
@@ -166,9 +174,9 @@ def _kernel():
     import jax.numpy as jnp
 
     from . import edwards as ed
-    from . import field as fe
     from . import scalar as sc
     from . import sha512 as sh
+    from .fieldsel import F as fe
 
     @jax.jit
     def kernel(ab, sb, msg, nblocks, s_ok, btab):
@@ -188,8 +196,8 @@ def _kernel():
         r_sign = sig_bytes[31] >> 7
         a_top = (a_bytes[31] & 0x7F)[None]
         r_top = (sig_bytes[31] & 0x7F)[None]
-        a_y = sc.bytes_to_limbs(jnp.concatenate([a_bytes[:31], a_top]), 22)
-        r_y = sc.bytes_to_limbs(jnp.concatenate([sig_bytes[:31], r_top]), 22)
+        a_y = fe.limbs_from_bytes(jnp.concatenate([a_bytes[:31], a_top]))
+        r_y = fe.limbs_from_bytes(jnp.concatenate([sig_bytes[:31], r_top]))
 
         # --- decompress A and R fused at width 2N (halves the number of
         # expensive sqrt-exponentiation op dispatches).

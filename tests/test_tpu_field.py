@@ -1,80 +1,120 @@
-"""Property tests: JAX limb field arithmetic vs Python big-int ground truth."""
+"""Property tests: JAX limb field arithmetic vs Python big-int ground truth.
+
+Parametrized over both representations (crypto/tpu/fieldsel.py):
+  * field      — 22 x 12-bit non-negative int32 limbs
+  * field_f32  — 32 x 8-bit signed float32 limbs (exactness relies on
+                 every value staying under 2^24; the adversarial
+                 all-max patterns here drive exactly those bounds)
+"""
 
 import numpy as np
 import pytest
 
-from tendermint_tpu.crypto.tpu import field as fe
+from tendermint_tpu.crypto.tpu import field as field_i32
+from tendermint_tpu.crypto.tpu import field_f32
 
-P = fe.P
+P = field_i32.P
 RNG = np.random.default_rng(1234)
 
 
-def rand_elems(n, bound=None):
-    """Random REDUCED limb batch (22, n) + matching Python ints."""
+@pytest.fixture(params=["i32", "f32"], ids=["i32", "f32"])
+def fe(request):
+    return field_i32 if request.param == "i32" else field_f32
+
+
+def check_bound(fe, out, what):
+    """REDUCED closure: non-negative for i32, symmetric for f32."""
+    lo = -(fe.REDUCED_BOUND - 1) if fe.SIGNED else 0
+    assert out.max() < fe.REDUCED_BOUND and out.min() >= lo, \
+        f"{what} broke REDUCED bound [{lo}, {fe.REDUCED_BOUND})"
+
+
+def rand_elems(fe, n, bound=None):
+    """Random REDUCED limb batch (NLIMB, n) + matching Python ints."""
     bound = bound or fe.REDUCED_BOUND
-    limbs = RNG.integers(0, bound, size=(fe.NLIMB, n), dtype=np.int64)
+    lo = -(bound - 1) if fe.SIGNED else 0
+    limbs = RNG.integers(lo, bound, size=(fe.NLIMB, n), dtype=np.int64)
     vals = fe.from_limbs(limbs)
-    return limbs.astype(np.int32), vals
+    return limbs.astype(np.asarray(fe.to_limbs(0)).dtype), vals
 
 
-def adversarial_elems():
-    """Near-max patterns: all limbs at the REDUCED bound, zeros, p, 2p-ish."""
+def adversarial_elems(fe):
+    """Near-max patterns: all limbs at the REDUCED bound (both signs
+    when the rep is signed), zeros, p, max representable, etc."""
+    max_rep = (1 << (fe.BITS * fe.NLIMB)) - 1
     cols = [
         np.full(fe.NLIMB, fe.REDUCED_BOUND - 1),
         np.zeros(fe.NLIMB),
-        np.full(fe.NLIMB, 4095),
+        np.full(fe.NLIMB, fe.MASK),
         fe.to_limbs(P),
-        fe.to_limbs(2 * P),
+        fe.to_limbs(2 * P) if 2 * P <= max_rep else fe.to_limbs(P - 2),
         fe.to_limbs(P - 1),
         fe.to_limbs(P + 1),
         fe.to_limbs(1),
-        fe.to_limbs((1 << 264) - 1),
+        fe.to_limbs(max_rep),
         fe.to_limbs(19),
     ]
-    limbs = np.stack(cols, axis=1).astype(np.int32)
-    return limbs, fe.from_limbs(limbs)
+    if fe.SIGNED:
+        cols.append(np.full(fe.NLIMB, -(fe.REDUCED_BOUND - 1)))
+        alt = np.full(fe.NLIMB, fe.REDUCED_BOUND - 1)
+        alt[::2] *= -1
+        cols.append(alt)
+    limbs = np.stack(cols, axis=1)
+    return (limbs.astype(np.asarray(fe.to_limbs(0)).dtype),
+            fe.from_limbs(limbs))
 
 
-def test_to_from_limbs_roundtrip():
-    for v in [0, 1, 19, P - 1, P, P + 1, 2**255 - 1, 2**264 - 1]:
+def test_to_from_limbs_roundtrip(fe):
+    max_rep = (1 << (fe.BITS * fe.NLIMB)) - 1
+    for v in [0, 1, 19, P - 1, P, P + 1, 2**255 - 1, max_rep]:
         assert fe.from_limbs(fe.to_limbs(v)) == v
 
 
 @pytest.mark.parametrize("op,pyop", [("add", lambda a, b: a + b), ("sub", lambda a, b: a - b)])
-def test_add_sub(op, pyop):
-    a_l, a_v = rand_elems(64)
-    b_l, b_v = rand_elems(64)
+def test_add_sub(fe, op, pyop):
+    a_l, a_v = rand_elems(fe, 64)
+    b_l, b_v = rand_elems(fe, 64)
     out = np.asarray(getattr(fe, op)(a_l, b_l))
-    assert out.max() < fe.REDUCED_BOUND and out.min() >= 0, f"{op} broke REDUCED bound"
+    check_bound(fe, out, op)
     for got, av, bv in zip(fe.from_limbs(out), a_v, b_v):
         assert got % P == pyop(av, bv) % P
 
 
-def test_mul_random():
-    a_l, a_v = rand_elems(128)
-    b_l, b_v = rand_elems(128)
+def test_mul_random(fe):
+    a_l, a_v = rand_elems(fe, 128)
+    b_l, b_v = rand_elems(fe, 128)
     out = np.asarray(fe.mul(a_l, b_l))
-    assert out.max() < fe.REDUCED_BOUND and out.min() >= 0, "mul broke REDUCED bound"
+    check_bound(fe, out, "mul")
     for got, av, bv in zip(fe.from_limbs(out), a_v, b_v):
         assert got % P == (av * bv) % P
 
 
-def test_mul_adversarial():
-    a_l, a_v = adversarial_elems()
+def test_mul_adversarial(fe):
+    a_l, a_v = adversarial_elems(fe)
     # all pairs
     n = a_l.shape[1]
     ii, jj = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
     aa = a_l[:, ii.ravel()]
     bb = a_l[:, jj.ravel()]
     out = np.asarray(fe.mul(aa, bb))
-    assert out.max() < fe.REDUCED_BOUND and out.min() >= 0
+    check_bound(fe, out, "mul")
     got = fe.from_limbs(out)
     for idx, (i, j) in enumerate(zip(ii.ravel(), jj.ravel())):
         assert got[idx] % P == (a_v[i] * a_v[j]) % P
 
 
+def test_sqr_adversarial(fe):
+    a_l, a_v = adversarial_elems(fe)
+    out = np.asarray(fe.sqr(a_l))
+    check_bound(fe, out, "sqr")
+    for got, v in zip(fe.from_limbs(out), a_v):
+        assert got % P == (v * v) % P
+
+
 def test_sub_never_negative_intermediate():
-    # max b against min a — the bias must keep every limb non-negative
+    # i32 rep only: max b against min a — the bias must keep every
+    # limb non-negative (the f32 rep is signed by design).
+    fe = field_i32
     a = np.zeros((fe.NLIMB, 1), np.int32)
     b = np.full((fe.NLIMB, 1), fe.REDUCED_BOUND - 1, np.int32)
     out = np.asarray(fe.sub(a, b))
@@ -82,19 +122,46 @@ def test_sub_never_negative_intermediate():
     assert fe.from_limbs(out)[0] % P == (0 - fe.from_limbs(b)[0]) % P
 
 
-def test_canonical():
-    a_l, a_v = adversarial_elems()
+def test_canonical(fe):
+    a_l, a_v = adversarial_elems(fe)
     out = np.asarray(fe.canonical(a_l))
     for got, v in zip(fe.from_limbs(out), a_v):
         assert got == v % P
         assert 0 <= got < P
-    r_l, r_v = rand_elems(64)
+    r_l, r_v = rand_elems(fe, 64)
     out = np.asarray(fe.canonical(r_l))
     for got, v in zip(fe.from_limbs(out), r_v):
         assert got == v % P
 
 
-def test_eq_and_is_zero():
+def test_canonical_signed_edges():
+    """f32 rep: values that stress the fold-carry convergence proof —
+    small negatives (borrow ripples), +/-1 around 0 and p, and the
+    all-negative-max pattern whose value is about -2.7 * 2^256."""
+    fe = field_f32
+    cases = [-1, -19, -38, -39, 1 - (1 << 256), P - 1, 1, 0]
+    vals = list(cases)
+    cols = [None] * len(vals)
+    # build signed limb decompositions exactly: v = sum limb_i 2^(8i)
+    for k, v in enumerate(vals):
+        x = v
+        limbs = np.zeros(fe.NLIMB, np.float64)
+        for i in range(fe.NLIMB):
+            r = x % 256 if i < fe.NLIMB - 1 else x
+            if i < fe.NLIMB - 1:
+                limbs[i] = r
+                x = (x - r) // 256
+            else:
+                limbs[i] = x
+        assert abs(limbs).max() < (1 << 22), "edge case fits f32 limbs"
+        cols[k] = limbs.astype(np.float32)
+    a = np.stack(cols, axis=1)
+    out = np.asarray(fe.canonical(a))
+    for got, v in zip(fe.from_limbs(out), vals):
+        assert got == v % P, f"canonical({v}) wrong"
+
+
+def test_eq_and_is_zero(fe):
     one = fe.splat(1, 4)
     p_plus_1 = fe.splat(P + 1, 4)
     assert np.asarray(fe.eq(one, p_plus_1)).all(), "1 != p+1 mod p?"
@@ -102,37 +169,57 @@ def test_eq_and_is_zero():
     assert not np.asarray(fe.is_zero(fe.splat(1, 3))).any()
 
 
-def test_parity():
+def test_parity(fe):
     # parity is of the canonical representative: p+1 ≡ 1 -> odd
     assert np.asarray(fe.parity(fe.splat(P + 1, 2)))[0] == 1
     assert np.asarray(fe.parity(fe.splat(P, 2)))[0] == 0
     assert np.asarray(fe.parity(fe.splat(4, 2)))[0] == 0
 
 
-def test_pow_2_252_m3():
-    a_l, a_v = rand_elems(16)
+def test_pow_2_252_m3(fe):
+    a_l, a_v = rand_elems(fe, 16)
     out = fe.from_limbs(np.asarray(fe.pow_2_252_m3(a_l)))
     e = (1 << 252) - 3
     for got, v in zip(out, a_v):
         assert got % P == pow(v % P, e, P)
 
 
-def test_neg():
-    a_l, a_v = rand_elems(32)
+def test_neg(fe):
+    a_l, a_v = rand_elems(fe, 32)
     out = fe.from_limbs(np.asarray(fe.neg(a_l)))
     for got, v in zip(out, a_v):
         assert got % P == (-v) % P
 
 
-def test_mul_chain_stability():
+def test_mul_chain_stability(fe):
     """Repeated squaring keeps the REDUCED bound (no drift)."""
-    a_l, a_v = rand_elems(8)
+    a_l, a_v = rand_elems(fe, 8)
     x = a_l
     v = list(a_v)
     for _ in range(50):
         x = fe.sqr(x)
         v = [(t * t) % P for t in v]
     x = np.asarray(x)
-    assert x.max() < fe.REDUCED_BOUND and x.min() >= 0
+    check_bound(fe, x, "sqr chain")
     for got, want in zip(fe.from_limbs(x), v):
         assert got % P == want
+
+
+def test_f32_matches_i32_differential():
+    """The two representations agree mul-for-mul on random inputs
+    (beyond both agreeing with Python ints — catches from_limbs bugs)."""
+    vals = [int(RNG.integers(0, 1 << 62)) * int(RNG.integers(0, 1 << 62))
+            % P for _ in range(32)]
+    vals += [0, 1, P - 1, P - 2, 2**255 - 20]
+    n = len(vals)
+    a32 = np.stack([field_i32.to_limbs(v) for v in vals], axis=1)
+    af = np.stack([field_f32.to_limbs(v) for v in vals], axis=1)
+    b32 = np.stack([field_i32.to_limbs(vals[(i + 7) % n])
+                    for i in range(n)], axis=1)
+    bf = np.stack([field_f32.to_limbs(vals[(i + 7) % n])
+                   for i in range(n)], axis=1)
+    m32 = field_i32.from_limbs(np.asarray(field_i32.canonical(
+        field_i32.mul(a32, b32))))
+    mf = field_f32.from_limbs(np.asarray(field_f32.canonical(
+        field_f32.mul(af, bf))))
+    assert m32 == mf

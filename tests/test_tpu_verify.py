@@ -7,8 +7,8 @@ import pytest
 
 from tendermint_tpu.crypto import ed25519_ref as ref
 from tendermint_tpu.crypto.tpu import edwards as ed
-from tendermint_tpu.crypto.tpu import field as fe
 from tendermint_tpu.crypto.tpu import verify as tv
+from tendermint_tpu.crypto.tpu.fieldsel import F as fe
 
 P = ref.P
 
