@@ -301,20 +301,37 @@ def worker():
         return exp.verify_structured(idxs, sb, csigs)
 
     p50_s = _measure(run_structured, 7, warmed=True)
+    # The recorded headline is the BEST product path for THIS real
+    # commit, compared apples-to-apples: the bytes path timed on the
+    # SAME ~187-byte canonical sign bytes (stage 2's number above used
+    # short synthetic messages — 1 SHA block vs ~2 — and is kept
+    # separately as synthetic_msgs_p50_ms).
+    real_msgs = [commit.vote_sign_bytes("bench-chain", i)
+                 for i in range(n)]
+    exp.verify(idxs, real_msgs, csigs)  # shape warm-up
+    p50_b = _measure(lambda: exp.verify(idxs, real_msgs, csigs),
+                     5, warmed=True)
+    structured_wins = p50_s < p50_b
+    p50_best = min(p50_s, p50_b)
     line_s = {
         **common,
-        "value": round(p50_s * 1e3, 3),
-        "vs_baseline": round(cpu_per_sig * n / p50_s, 2),
-        "sigs_per_sec": round(n / p50_s),
+        "value": round(p50_best * 1e3, 3),
+        "vs_baseline": round(cpu_per_sig * n / p50_best, 2),
+        "sigs_per_sec": round(n / p50_best),
         "batch": n,
         "expanded_valset": True,
         "structured_commit": True,
-        "note": "real %d-sig commit; sign bytes device-assembled "
-                "(template + per-lane ts patch); includes per-commit "
-                "host batch build" % n,
+        "winner": "structured" if structured_wins else "bytes",
+        "note": "real %d-sig commit; best of structured "
+                "(device-assembled sign bytes) vs bytes path on the "
+                "same commit" % n,
         "fastsync_block_1k_vals_p50_ms":
             line.get("fastsync_block_1k_vals_p50_ms"),
-        "bytes_path_p50_ms": line["value"],
+        "bytes_path_p50_ms": round(p50_b * 1e3, 3),
+        "structured_path_p50_ms": round(p50_s * 1e3, 3),
+        "synthetic_msgs_p50_ms": line["value"],
+        "device_exec_ms_per_launch":
+            line.get("device_exec_ms_per_launch"),
     }
     _emit(line_s)
 

@@ -142,14 +142,15 @@ def _xcore(wpi: int = WINDOWS_PER_ITER):
         digest = sh.compress_blocks(sh.bytes_to_words(full), nblocks)
         digk = sc.fold_digest(sh.digest_bytes_le(digest))[::-1]  # LSB-first
         # Signed recode: nibbles (0..15) -> digits in [-8, 8] with
-        # carry, scanning LSB -> MSB. The folded value is < 2^271 so
-        # nibble 68 is 0 and the final carry is absorbed (d_68 <= 1).
-        def recode(carry, nib):
-            t = nib + carry
-            hi = (t >= 8).astype(jnp.int32)
-            return hi, t - 16 * hi
+        # binary carries LSB -> MSB (nib + c >= 8 emits). The folded
+        # value is < 2^271 so nibble 68 is 0 and the final carry is
+        # absorbed (d_68 <= 1). Log-depth carry lookahead instead of a
+        # 69-step sequential scan (fixed launch latency).
+        from . import field as _field
 
-        _, digk = jax.lax.scan(recode, jnp.zeros(n, jnp.int32), digk)
+        cin, _ = _field.carry_lookahead(digk >= 8, digk >= 7)
+        t = digk + cin.astype(jnp.int32)
+        digk = t - 16 * (t >= 8).astype(jnp.int32)
         sig_bytes = sb.astype(jnp.int32).T  # (64, N)
         digs = sc.bytes_to_nibbles(sig_bytes[32:])  # (64, N) LSB-first
         digs = jnp.concatenate(

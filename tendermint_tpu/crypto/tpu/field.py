@@ -230,7 +230,11 @@ def _reduce43(c: jnp.ndarray) -> jnp.ndarray:
 
 
 def _ripple22(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Exact sequential carry: limbs in [0, 4096) plus signed out-carry."""
+    """Exact sequential carry: limbs in [0, 4096) plus signed out-carry.
+
+    Kept as the reference implementation for _ks_norm's differential
+    tests; the kernels use the log-depth version below.
+    """
 
     def step(carry, limb):
         v = limb + carry
@@ -240,21 +244,69 @@ def _ripple22(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     return limbs, out_c
 
 
+def carry_lookahead(g: jnp.ndarray, p: jnp.ndarray):
+    """Kogge-Stone prefix over (generate, propagate) bool rows.
+
+    g[i]: limb i emits a carry regardless of carry-in; p[i]: limb i
+    emits a carry iff it receives one. Returns (carry-in per limb,
+    top carry-out) in log2(K) parallel steps — the exact-normalization
+    scans this replaces were 22-69 SEQUENTIAL lax.scan steps each, a
+    measurable slice of the kernel's fixed per-launch latency.
+    """
+    G, Pp = g, p
+    shift = 1
+    k = g.shape[0]
+    while shift < k:
+        zg = jnp.zeros_like(G[:shift])
+        G = G | (Pp & jnp.concatenate([zg, G[:-shift]], axis=0))
+        Pp = Pp & jnp.concatenate([zg, Pp[:-shift]], axis=0)
+        shift <<= 1
+    cin = jnp.concatenate([jnp.zeros_like(G[:1]), G[:-1]], axis=0)
+    return cin, G[-1]
+
+
+def _ks_norm(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact carry normalization for limbs in [0, 2*4096): equivalent
+    to _ripple22 (limbs -> [0, 4096) + out-carry in {0, 1}) but
+    log-depth. Precondition: every limb <= 8190 and every
+    (limb + carry-in) <= 8191, so per-limb carries are binary —
+    callers establish this with one _pass22 first.
+    """
+    g = x >= 4096
+    p = x >= 4095
+    cin, cout = carry_lookahead(g, p)
+    return (x + cin.astype(jnp.int32)) & MASK, cout.astype(jnp.int32)
+
+
 def canonical(x: jnp.ndarray) -> jnp.ndarray:
-    """Unique representative in [0, p) with 12-bit limbs. Off hot path."""
-    l1, c1 = _ripple22(x)  # c1 in [0, 4] for REDUCED-ish input
-    l1 = _fold_top(l1, c1)
-    l2, _ = _ripple22(l1)  # value now < 2^264, carry 0
-    # Reduce 264 -> 255 bits: bits 255.. of limb 21 re-enter as *19.
-    hi = l2[21] >> 3
-    l2 = l2.at[21].set(l2[21] & 7)
-    l2 = l2.at[0].add(hi * 19)
-    l3, _ = _ripple22(l2)  # value < 2^255 + 9728 < 2p
+    """Unique representative in [0, p) with 12-bit limbs. All
+    log-depth: one parallel pass bounds limbs under 2*4096, then
+    Kogge-Stone exact normalizations (5 steps each) replace the
+    sequential ripples.
+    """
+    # REDUCED-ish input (< 7700): one pass -> limbs <= 4095 + 3584
+    # (fold on limb 0) < 8190, carries binary from here on.
+    l1 = _pass22(x)
+    l1, c1 = _ks_norm(l1)
+    l1 = _fold_top(l1, c1)  # limb0 += <=3584, limb1 += <=2 -> <= 8190
+    # After this fold the value is < 2^264: the pass bounded the value
+    # under ~1.001 * 2^264, so c1=1 implies the remainder was tiny and
+    # re-adding 19*2^9 cannot reach 2^264 again -> top carry is 0.
+    l2, _ = _ks_norm(l1)
+    # Reduce 264 -> 255 bits: bits 255.. of limb 21 re-enter as *19,
+    # split across limbs 0/1 to keep carries binary (19*hi <= 9709
+    # added whole would break the <= 8190 precondition).
+    hi19 = (l2[21] >> 3) * 19
+    l2 = jnp.concatenate(
+        [(l2[0] + (hi19 & MASK))[None],
+         (l2[1] + (hi19 >> BITS))[None],
+         l2[2:21], (l2[21] & 7)[None]], axis=0)
+    l3, _ = _ks_norm(l2)  # value < 2^255 + 9728 < 2p
     # Conditional subtract: value >= p  iff  value + 19 >= 2^255.
-    t = l3.at[0].add(19)
-    t4, _ = _ripple22(t)
+    t = jnp.concatenate([(l3[0] + 19)[None], l3[1:]], axis=0)
+    t4, _ = _ks_norm(t)
     ge = (t4[21] >> 3) > 0
-    sub_p = t4.at[21].set(t4[21] & 7)
+    sub_p = jnp.concatenate([t4[:21], (t4[21] & 7)[None]], axis=0)
     return jnp.where(ge, sub_p, l3)
 
 

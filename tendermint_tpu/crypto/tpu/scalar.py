@@ -94,13 +94,15 @@ def fold_digest(digest_rows):
     c = acc >> BITS
     r = acc & MASK
     acc = jnp.concatenate([r[:1], r[1:] + c[:-1]], axis=0)
-    # Exact final ripple (parallel passes can leave a limb at 4096):
-    # 23 sequential steps over (N,) lanes — trivially cheap, and the
-    # nibble extraction below requires limbs strictly < 4096.
-    def step(carry, limb):
-        v = limb + carry
-        return v >> BITS, v & MASK
-    _, acc = jax.lax.scan(step, jnp.zeros(acc.shape[-1], jnp.int32), acc)
+    # Exact final normalization (parallel passes can leave a limb as
+    # high as 4095 + 45; the nibble extraction below requires limbs
+    # strictly < 4096). Carries are binary here — inside _ks_norm's
+    # precondition — so the log-depth lookahead replaces what used to
+    # be a 23-step sequential scan (per-launch latency on TPU). No
+    # top fold: this is a plain integer, width 23 limbs > 271 bits.
+    from . import field as _field
+
+    acc, _ = _field._ks_norm(acc)
     nibs = limbs_to_nibbles(acc)  # (69, N) LSB-first
     return nibs[::-1]
 

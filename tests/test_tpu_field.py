@@ -205,6 +205,34 @@ def test_mul_chain_stability(fe):
         assert got % P == want
 
 
+def test_carry_lookahead_matches_ripple():
+    """The log-depth Kogge-Stone normalization must agree with the
+    sequential ripple on every input in its precondition range
+    (limbs <= 8190, carries binary), including long propagate chains
+    (4095 runs) and generate-at-top patterns."""
+    fe = field_i32
+    cols = [
+        np.full(fe.NLIMB, 4095),                 # all-propagate
+        np.full(fe.NLIMB, 4096),                 # all-generate
+        np.full(fe.NLIMB, 8190),                 # max precondition
+        np.zeros(fe.NLIMB),
+    ]
+    chain = np.full(fe.NLIMB, 4095)
+    chain[0] = 4096                              # carry ripples to top
+    cols.append(chain)
+    rng = np.random.default_rng(7)
+    for _ in range(64):
+        cols.append(rng.integers(0, 8191, fe.NLIMB))
+    x = np.stack(cols, axis=1).astype(np.int32)
+    want_l, want_c = (np.asarray(v) for v in fe._ripple22(x))
+    got_l, got_c = (np.asarray(v) for v in fe._ks_norm(x))
+    # _ripple22 carries multi-bit out of intermediate limbs only when
+    # limbs exceed the binary range; within the precondition both must
+    # agree exactly.
+    assert (got_l == want_l).all()
+    assert (got_c == want_c).all()
+
+
 def test_f32_matches_i32_differential():
     """The two representations agree mul-for-mul on random inputs
     (beyond both agreeing with Python ints — catches from_limbs bugs)."""
