@@ -252,6 +252,11 @@ def worker():
         line["device_exec_ms_per_launch"] = (
             round(dev_pipe * 1e3, 3) if dev_pipe else None)
         line["single_launch_synced_ms"] = round(dev_single * 1e3, 3)
+        if dev_pipe:
+            # Pure device throughput with launches in flight — the
+            # production vote-scheduler shape (batches pipeline behind
+            # one sync; host pack overlaps the previous launch).
+            line["device_sigs_per_sec_pipelined"] = round(n / dev_pipe)
         _emit(line)
 
     # Fast-sync through the WARM 10k tables (1k-lane subset).
