@@ -27,7 +27,17 @@ from . import PubKey
 logger = logging.getLogger("crypto.batch")
 
 # Below this many sigs, host verification beats the device round trip.
-_DEVICE_THRESHOLD = 16
+# Round-4 silicon derivation (docs/THRESHOLDS.md): device cost at small
+# batches is the fixed launch term (~5.5 ms exec at wpi=3) vs host
+# OpenSSL ~0.15 ms/sig -> crossover ~40 sigs co-located. (Through the
+# axon relay the crossover is ~10x higher — RTT-dominated — but the
+# scheduler verifies off-loop, so the threshold targets the co-located
+# design point.)
+_DEVICE_THRESHOLD = 40
+# sr25519 has no OpenSSL fast path — the host oracle costs ~5.5 ms/sig
+# (pure Python + SIMD Merlin), ~37x ed25519's — so its device
+# crossover is a handful of lanes, not 40.
+_DEVICE_THRESHOLD_SR = 4
 
 # Device-failure degradation: a kernel launch raising (wedged relay,
 # OOM, backend death) marks the device down for a cooldown; every
@@ -133,7 +143,7 @@ class BatchVerifier:
         if type_name == "sr25519":
             use_dev = self._use_device
             if use_dev is None:
-                use_dev = len(items) >= _DEVICE_THRESHOLD
+                use_dev = len(items) >= _DEVICE_THRESHOLD_SR
             if use_dev and device_available():
                 try:
                     from .tpu import sr_verify

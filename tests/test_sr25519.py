@@ -246,9 +246,20 @@ def test_sr25519_device_batch_parity():
 
 
 def test_batch_verifier_routes_sr25519_to_device():
-    """>= _DEVICE_THRESHOLD sr25519 lanes take the device path inside
-    the product BatchVerifier (BASELINE config #4 mixed batches)."""
-    n = 20
+    """>= _DEVICE_THRESHOLD_SR sr25519 lanes take the device path
+    inside the product BatchVerifier (BASELINE config #4 mixed
+    batches) — asserted via the backend lane counter, so a silent
+    host fallback cannot fake a pass."""
+    import time
+
+    from tendermint_tpu.crypto import batch as batch_mod
+    from tendermint_tpu.libs.metrics import crypto_metrics
+
+    batch_mod._device_down_until = 0.0  # clear any cooldown from
+    # earlier tests — this test is about routing, not degradation
+    n = batch_mod._DEVICE_THRESHOLD_SR + 16
+    lanes_before = crypto_metrics().batch_lanes.value(
+        backend="tpu-sr25519")
     minis = [hashlib.sha256(b"rt%d" % i).digest() for i in range(n)]
     bv = BatchVerifier()
     for i, mini in enumerate(minis):
@@ -263,3 +274,5 @@ def test_batch_verifier_routes_sr25519_to_device():
     want = np.ones(n, bool)
     want[9] = False
     assert (verdicts == want).all()
+    assert (crypto_metrics().batch_lanes.value(backend="tpu-sr25519")
+            == lanes_before + n), "sr25519 lanes did not take the device path"
