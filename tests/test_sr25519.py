@@ -250,8 +250,6 @@ def test_batch_verifier_routes_sr25519_to_device():
     inside the product BatchVerifier (BASELINE config #4 mixed
     batches) — asserted via the backend lane counter, so a silent
     host fallback cannot fake a pass."""
-    import time
-
     from tendermint_tpu.crypto import batch as batch_mod
     from tendermint_tpu.libs.metrics import crypto_metrics
 

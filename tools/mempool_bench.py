@@ -1,0 +1,88 @@
+"""Mempool micro-benchmarks (reference: mempool/bench_test.go —
+BenchmarkCheckTx / BenchmarkReap / BenchmarkCacheInsertTime /
+BenchmarkCacheRemoveTime).
+
+Measures the same four surfaces against the kvstore app over the
+local ABCI client, printed as one table:
+
+    python tools/mempool_bench.py [--size 10000]
+"""
+
+import asyncio
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tendermint_tpu.abci.client import LocalClient          # noqa: E402
+from tendermint_tpu.abci.kvstore import KVStoreApp          # noqa: E402
+from tendermint_tpu.config import MempoolConfig             # noqa: E402
+from tendermint_tpu.mempool.clist_mempool import (          # noqa: E402
+    CListMempool, TxCache,
+)
+
+
+def tx(i: int) -> bytes:
+    return i.to_bytes(8, "big")
+
+
+async def bench_check_tx(n: int) -> float:
+    pool = CListMempool(
+        MempoolConfig(size=n + 10, cache_size=n + 10, recheck=False),
+        LocalClient(KVStoreApp()))
+    t0 = time.perf_counter()
+    for i in range(n):
+        await pool.check_tx(tx(i))
+    dt = time.perf_counter() - t0
+    assert pool.size() == n
+    return n / dt
+
+
+async def bench_reap(n: int, reps: int = 50) -> float:
+    pool = CListMempool(
+        MempoolConfig(size=n + 10, cache_size=n + 10, recheck=False),
+        LocalClient(KVStoreApp()))
+    for i in range(n):
+        await pool.check_tx(tx(i))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        got = pool.reap_max_bytes_max_gas(100_000_000, 10_000_000)
+        ts.append(time.perf_counter() - t0)
+        assert len(got) == n
+    return sorted(ts)[len(ts) // 2]
+
+
+def bench_cache(n: int) -> tuple[float, float]:
+    cache = TxCache(n)
+    keys = [tx(i) for i in range(n)]
+    t0 = time.perf_counter()
+    for k in keys:
+        cache.push(k)
+    t_push = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for k in keys:
+        cache.remove(k)
+    t_rm = time.perf_counter() - t0
+    return n / t_push, n / t_rm
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--size", type=int, default=10_000)
+    n = ap.parse_args().size
+    check_rate = asyncio.run(bench_check_tx(n))
+    reap_p50 = asyncio.run(bench_reap(n))
+    push_rate, rm_rate = bench_cache(n)
+    print(f"mempool bench @ {n} txs (kvstore app, local ABCI client)")
+    print(f"  check_tx            {check_rate:12,.0f} tx/s")
+    print(f"  reap(all, p50)      {reap_p50 * 1e3:12.2f} ms")
+    print(f"  cache push          {push_rate:12,.0f} op/s")
+    print(f"  cache remove        {rm_rate:12,.0f} op/s")
+
+
+if __name__ == "__main__":
+    main()
