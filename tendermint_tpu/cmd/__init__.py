@@ -1,8 +1,9 @@
 """Command-line interface (reference: cmd/tendermint/main.go:15-45).
 
-Subcommands: init, start, testnet, light, replay, unsafe-reset-all,
-debug kill|dump, gen-validator, show-validator, gen-node-key,
-show-node-id, version. argparse instead of cobra; same behaviors."""
+Subcommands: init, start, testnet, light, replay, replay-console,
+unsafe-reset-all, unsafe-reset-priv-validator, debug kill|dump,
+gen-validator, show-validator, gen-node-key, show-node-id, probe-upnp,
+version. argparse instead of cobra; same behaviors."""
 
 from __future__ import annotations
 
@@ -271,18 +272,86 @@ def cmd_replay(args) -> int:
     return 0
 
 
+def cmd_replay_console(args) -> int:
+    """Interactive WAL replay (reference: replay.go ReplayConsoleCmd →
+    RunReplayFile(console=true)): step through the consensus WAL
+    message by message — Enter advances one message, a number advances
+    that many, 'q' quits. Read-only: decodes the WAL without mutating
+    any store, so it is safe on a live node's data directory copy."""
+    from ..consensus import wal as walmod
+
+    cfg = _load_config(args.home)
+    wal_path = cfg.base.resolve(cfg.consensus.wal_file)
+    if not os.path.exists(wal_path):
+        print(f"no WAL at {wal_path}")
+        return 1
+    # Strictly read-only (works on a read-only mount) and streamed one
+    # segment at a time — a full WAL group is up to 1 GiB on disk, far
+    # more as decoded Python objects.
+    segs = [p for p in walmod.segment_paths(wal_path) if os.path.exists(p)]
+    print(f"WAL group: {len(segs)} segment(s) at {wal_path}")
+    i = 0
+    step = 0
+    for seg in segs:
+        for tm in walmod.WAL.decode_all(seg):
+            if step <= 0:
+                try:
+                    line = input(f"[{i}] Enter=next, N=skip N, "
+                                 "q=quit > ").strip()
+                except EOFError:
+                    line = "q"
+                if line == "q":
+                    return 0
+                step = int(line) if line.isdigit() else 1
+            step -= 1
+            print(f"  #{i} t={tm.time_ns} "
+                  f"{type(tm.msg).__name__}: {tm.msg}")
+            i += 1
+    print(f"end of WAL ({i} messages)")
+    return 0
+
+
 def cmd_unsafe_reset_all(args) -> int:
-    """reference: cmd/tendermint/commands/reset_priv_validator.go."""
+    """reference: cmd/tendermint/commands/reset_priv_validator.go
+    ResetAll — remove data + WAL (+ addrbook unless --keep-addr-book),
+    reset the validator's last-sign state."""
     cfg = _load_config(args.home)
     data = cfg.base.resolve(cfg.base.db_dir)
     if os.path.isdir(data):
         shutil.rmtree(data)
         os.makedirs(data)
         print(f"Removed all data in {data}")
+    book = cfg.base.resolve("config/addrbook.json")
+    if getattr(args, "keep_addr_book", False):
+        print("The address book remains intact")
+    elif os.path.exists(book):
+        os.remove(book)
+        print(f"Removed existing address book {book}")
     state_file = cfg.base.resolve(cfg.base.priv_validator_state_file)
     if os.path.exists(state_file):
         os.remove(state_file)
     print("Reset private validator state")
+    return 0
+
+
+def cmd_unsafe_reset_priv_validator(args) -> int:
+    """reference: reset_priv_validator.go ResetPrivValidatorCmd —
+    reset ONLY this node's validator to genesis state: regenerate the
+    key file if missing and wipe the last-sign state (the double-sign
+    guard's HRS record). Data/WAL/addrbook stay intact."""
+    from ..privval import FilePV
+
+    cfg = _load_config(args.home)
+    key_file = cfg.base.resolve(cfg.base.priv_validator_key_file)
+    state_file = cfg.base.resolve(cfg.base.priv_validator_state_file)
+    if os.path.exists(state_file):
+        os.remove(state_file)
+        print(f"Reset private validator state {state_file}")
+    if os.path.exists(key_file):
+        print(f"Private validator key intact at {key_file}")
+    else:
+        FilePV.generate(key_file, state_file)
+        print(f"Generated private validator key {key_file}")
     return 0
 
 
@@ -406,9 +475,22 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("replay", help="replay the consensus WAL")
     sp.set_defaults(fn=cmd_replay)
 
+    sp = sub.add_parser("replay-console",
+                        help="step through the consensus WAL "
+                             "interactively (read-only)")
+    sp.set_defaults(fn=cmd_replay_console)
+
     sp = sub.add_parser("unsafe-reset-all",
-                        help="wipe data, keep keys and config")
+                        help="wipe data and addrbook, keep keys "
+                             "and config")
+    sp.add_argument("--keep-addr-book", action="store_true",
+                    help="keep the address book intact")
     sp.set_defaults(fn=cmd_unsafe_reset_all)
+
+    sp = sub.add_parser("unsafe-reset-priv-validator",
+                        help="reset only this node's validator to "
+                             "genesis state (wipes last-sign state)")
+    sp.set_defaults(fn=cmd_unsafe_reset_priv_validator)
 
     from .debug import register as register_debug
 

@@ -162,6 +162,24 @@ class WALCorruptionError(Exception):
     pass
 
 
+def rotated_indices(path: str) -> list[int]:
+    """Indices of rotated segments next to a WAL head path. Module
+    level (not a method) so read-only consumers — replay-console —
+    can enumerate segments without opening the head for append."""
+    d = os.path.dirname(path) or "."
+    base = os.path.basename(path) + "."
+    out = []
+    for name in os.listdir(d):
+        if name.startswith(base) and name[len(base):].isdigit():
+            out.append(int(name[len(base):]))
+    return sorted(out)
+
+
+def segment_paths(path: str) -> list[str]:
+    """All segment files for a WAL head path, oldest first, head last."""
+    return [f"{path}.{i:03d}" for i in rotated_indices(path)] + [path]
+
+
 class WAL:
     """File-backed WAL with size-bounded rotation. write() buffers;
     write_sync() flushes + fsyncs. The consensus loop write_sync's
@@ -193,18 +211,11 @@ class WAL:
     # -- segments --
 
     def _rotated_indices(self) -> list[int]:
-        d = os.path.dirname(self.path) or "."
-        base = os.path.basename(self.path) + "."
-        out = []
-        for name in os.listdir(d):
-            if name.startswith(base) and name[len(base):].isdigit():
-                out.append(int(name[len(base):]))
-        return sorted(out)
+        return rotated_indices(self.path)
 
     def segment_paths(self) -> list[str]:
         """All segment files, oldest first, head last."""
-        return [f"{self.path}.{i:03d}" for i in self._rotated_indices()] \
-            + [self.path]
+        return segment_paths(self.path)
 
     def _rotate(self) -> None:
         self.flush_and_sync()

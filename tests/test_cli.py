@@ -192,3 +192,71 @@ def test_light_command_once(tmp_path, capsys):
             await a.stop()
 
     asyncio.run(go())
+
+
+def test_unsafe_reset_priv_validator(tmp_path, capsys):
+    """reference reset_priv_validator.go: wipes ONLY the last-sign
+    state; key file survives (or is regenerated when absent); data
+    stays intact."""
+    home = str(tmp_path / "home")
+    assert main(["--home", home, "init"]) == 0
+    key_file = os.path.join(home, "config/priv_validator_key.json")
+    state_file = os.path.join(home, "data/priv_validator_state.json")
+    key_before = open(key_file).read()
+    os.makedirs(os.path.dirname(state_file), exist_ok=True)
+    with open(state_file, "w") as f:
+        json.dump({"height": 7, "round": 1, "step": 3}, f)
+    data_marker = os.path.join(home, "data", "blockstore.db")
+    open(data_marker, "w").close()
+
+    assert main(["--home", home, "unsafe-reset-priv-validator"]) == 0
+    assert not os.path.exists(state_file), "last-sign state must be wiped"
+    assert open(key_file).read() == key_before, "key must survive"
+    assert os.path.exists(data_marker), "data must stay intact"
+
+    os.remove(key_file)
+    assert main(["--home", home, "unsafe-reset-priv-validator"]) == 0
+    assert os.path.exists(key_file), "missing key must be regenerated"
+
+
+def test_unsafe_reset_all_addrbook_flag(tmp_path, capsys):
+    home = str(tmp_path / "home")
+    assert main(["--home", home, "init"]) == 0
+    book = os.path.join(home, "config", "addrbook.json")
+    with open(book, "w") as f:
+        f.write("{}")
+    assert main(["--home", home, "unsafe-reset-all",
+                 "--keep-addr-book"]) == 0
+    assert os.path.exists(book), "--keep-addr-book must preserve it"
+    assert main(["--home", home, "unsafe-reset-all"]) == 0
+    assert not os.path.exists(book), "default reset removes the addrbook"
+
+
+def test_replay_console_steps_and_quits(tmp_path, capsys, monkeypatch):
+    """replay-console decodes the rotated WAL read-only and steps on
+    input; 'q' exits early, missing WAL is a clean error."""
+    from tendermint_tpu.consensus import wal as walmod
+
+    home = str(tmp_path / "home")
+    assert main(["--home", home, "init"]) == 0
+    assert main(["--home", home, "replay-console"]) == 1  # no WAL yet
+
+    wal_path = os.path.join(home, "data", "cs.wal", "wal")
+    w = walmod.WAL(wal_path)
+    for h in (1, 2):
+        w.write(walmod.EndHeightMessage(h), time_ns=h * 1000)
+    w.flush_and_sync()
+    w.close()
+
+    feeds = iter(["", "q"])  # step one, then quit
+    monkeypatch.setattr("builtins.input", lambda *_: next(feeds))
+    capsys.readouterr()
+    # read-only: must work with the WAL files write-protected
+    os.chmod(wal_path, 0o444)
+    try:
+        assert main(["--home", home, "replay-console"]) == 0
+    finally:
+        os.chmod(wal_path, 0o644)
+    out = capsys.readouterr().out
+    assert "1 segment(s)" in out
+    assert "EndHeightMessage" in out
