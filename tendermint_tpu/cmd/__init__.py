@@ -25,6 +25,11 @@ def _load_config(home: str):
     path = os.path.join(home, "config", "config.toml")
     if os.path.exists(path):
         cfg = Config.load(path)
+        # Reject typo'd values loudly (e.g. tx_index.indexer =
+        # "nulll" silently meaning "kv") instead of running with a
+        # config the operator didn't ask for — reference
+        # config.ValidateBasic on the CLI load path.
+        cfg.validate_basic()
     else:
         cfg = Config()
     cfg.base.home = home

@@ -191,6 +191,19 @@ class InstrumentationConfig:
 
 
 @dataclass
+class TxIndexConfig:
+    """reference: config/config.go:976 TxIndexConfig — which indexer
+    backs /tx_search and /block_search: "kv" (default) or "null"
+    (indexing disabled; the search RPCs then error)."""
+
+    indexer: str = "kv"
+
+    def validate_basic(self) -> None:
+        if self.indexer not in ("kv", "null"):
+            raise ValueError(f"unknown tx_index.indexer {self.indexer!r}")
+
+
+@dataclass
 class Config:
     base: BaseConfig = field(default_factory=BaseConfig)
     rpc: RPCConfig = field(default_factory=RPCConfig)
@@ -199,6 +212,7 @@ class Config:
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     fastsync: FastSyncConfig = field(default_factory=FastSyncConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig
     )
@@ -210,6 +224,7 @@ class Config:
         self.statesync.validate_basic()
         self.fastsync.validate_basic()
         self.consensus.validate_basic()
+        self.tx_index.validate_basic()
 
     # -- file round trip (flat TOML-ish key=value per [section]) --
 
@@ -218,7 +233,8 @@ class Config:
 
         lines = []
         for section_name in ("base", "rpc", "p2p", "mempool", "statesync",
-                             "fastsync", "consensus", "instrumentation"):
+                             "fastsync", "consensus", "tx_index",
+                             "instrumentation"):
             section = getattr(self, section_name)
             lines.append(f"[{section_name}]")
             for f in dataclasses.fields(section):

@@ -163,12 +163,21 @@ class Node(Service):
         from ..state.txindex import (BlockIndexer, IndexerService,
                                      TxIndexer)
 
-        self.tx_indexer = TxIndexer(_db(cfg, "txindex", self.in_memory))
-        self.block_indexer = BlockIndexer(
-            _db(cfg, "blockindex", self.in_memory))
-        self.indexer_service = IndexerService(
-            self.tx_indexer, self.event_bus,
-            block_indexer=self.block_indexer)
+        if cfg.tx_index.indexer == "null":
+            # reference config/config.go:976: indexing disabled —
+            # /tx, /tx_search, /block_search error out (rpc/core.py
+            # already guards on None indexers).
+            self.tx_indexer = None
+            self.block_indexer = None
+            self.indexer_service = None
+        else:
+            self.tx_indexer = TxIndexer(_db(cfg, "txindex",
+                                            self.in_memory))
+            self.block_indexer = BlockIndexer(
+                _db(cfg, "blockindex", self.in_memory))
+            self.indexer_service = IndexerService(
+                self.tx_indexer, self.event_bus,
+                block_indexer=self.block_indexer)
         self.mempool = CListMempool(cfg.mempool, self.proxy_app.mempool,
                                     height=self.state.last_block_height)
         self.block_exec = BlockExecutor(
@@ -308,7 +317,8 @@ class Node(Service):
         if not self._built:
             await self._build()
         cfg = self.config
-        self.indexer_service.start()
+        if self.indexer_service is not None:
+            self.indexer_service.start()
         # RPC first, so operators can inspect a node that hangs during
         # sync (reference node.go:865 starts RPC before the switch)
         self.rpc_server = None
@@ -430,7 +440,8 @@ class Node(Service):
             self.debug_server.close()
         if getattr(self, "prometheus_server", None) is not None:
             self.prometheus_server.close()
-        self.indexer_service.stop()
+        if self.indexer_service is not None:
+            self.indexer_service.stop()
         if self.consensus_state.is_running:
             await self.consensus_state.stop()
         for r in ("bc_reactor", "mempool_reactor", "ev_reactor"):

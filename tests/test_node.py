@@ -157,3 +157,38 @@ def test_trust_metric_wired_into_live_node(tmp_path):
         store.close()
 
     run(go())
+
+
+def test_null_tx_indexer_disables_search(tmp_path):
+    """tx_index.indexer = "null" (reference config.go TxIndexConfig):
+    the node runs without indexers and the search RPCs error."""
+
+    async def go():
+        gdoc, pvs = single_val_genesis()
+        cfg = make_home(tmp_path, "nullidx", gdoc)
+        cfg.tx_index.indexer = "null"
+        pv = pvs[0]
+        pv.key_path = cfg.base.resolve(cfg.base.priv_validator_key_file)
+        pv.state_path = cfg.base.resolve(cfg.base.priv_validator_state_file)
+        pv.save_key()
+
+        from tendermint_tpu.rpc.core import RPCError
+
+        node = Node.default_new_node(cfg)
+        await node.start()
+        try:
+            assert node.indexer_service is None
+            await node.consensus_state.wait_for_height(2, timeout=60)
+            env = node.rpc_env()
+            for coro in (env.tx(None, hash="ab" * 32),
+                         env.tx_search(None, query="tx.height=1"),
+                         env.block_search(None, query="block.height=1")):
+                try:
+                    await coro
+                    raise AssertionError("expected RPCError")
+                except RPCError as e:
+                    assert "disabled" in str(e.message)
+        finally:
+            await node.stop()
+
+    run(go())
