@@ -28,8 +28,12 @@ def _load_config(home: str):
         # Reject typo'd values loudly (e.g. tx_index.indexer =
         # "nulll" silently meaning "kv") instead of running with a
         # config the operator didn't ask for — reference
-        # config.ValidateBasic on the CLI load path.
-        cfg.validate_basic()
+        # config.ValidateBasic on the CLI load path. Clean one-line
+        # CLI error, not a traceback.
+        try:
+            cfg.validate_basic()
+        except ValueError as e:
+            raise SystemExit(f"invalid config {path}: {e}")
     else:
         cfg = Config()
     cfg.base.home = home

@@ -314,15 +314,24 @@ class ConsensusState(Service):
         ):
             return  # stale
         step = RoundStep(ti.step)
+
+        def fire(publisher_name):  # reference state.go:854-864
+            if self.event_bus is not None:
+                getattr(self.event_bus, publisher_name)(
+                    EventDataRoundState(ti.height, ti.round, step.name))
+
         if step == RoundStep.NEW_HEIGHT:
             await self._enter_new_round(ti.height, 0)
         elif step == RoundStep.NEW_ROUND:
             await self._enter_propose(ti.height, 0)
         elif step == RoundStep.PROPOSE:
+            fire("publish_timeout_propose")
             await self._enter_prevote(ti.height, ti.round)
         elif step == RoundStep.PREVOTE_WAIT:
+            fire("publish_timeout_wait")
             await self._enter_precommit(ti.height, ti.round)
         elif step == RoundStep.PRECOMMIT_WAIT:
+            fire("publish_timeout_wait")
             await self._enter_precommit(ti.height, ti.round)
             await self._enter_new_round(ti.height, ti.round + 1)
 
@@ -446,6 +455,10 @@ class ConsensusState(Service):
                 rs.valid_round = rs.round
                 rs.valid_block = rs.proposal_block
                 rs.valid_block_parts = rs.proposal_block_parts
+                if self.event_bus is not None:  # state.go:1450
+                    self.event_bus.publish_valid_block(
+                        EventDataRoundState(rs.height, rs.round,
+                                            rs.step.name))
         if rs.step <= RoundStep.PROPOSE and rs.proposal_complete():
             await self._enter_prevote(rs.height, rs.round)
             if has_maj:
@@ -514,11 +527,14 @@ class ConsensusState(Service):
 
         if self.event_bus is not None:
             self.event_bus.publish_polka(EventDataRoundState(
-                height, round_, "Polka"
+                height, round_, rs.step.name
             ))
 
         if bid is None or bid.is_nil():
             # +2/3 prevoted nil: unlock and precommit nil (state.go:1320)
+            if rs.locked_block is not None and self.event_bus is not None:
+                self.event_bus.publish_unlock(EventDataRoundState(
+                    height, round_, rs.step.name))
             rs.locked_round = -1
             rs.locked_block = None
             rs.locked_block_parts = None
@@ -528,6 +544,9 @@ class ConsensusState(Service):
         # +2/3 for a block
         if rs.locked_block is not None and rs.locked_block.hash() == bid.hash:
             rs.locked_round = round_  # re-lock at this round
+            if self.event_bus is not None:  # state.go:1327
+                self.event_bus.publish_relock(EventDataRoundState(
+                    height, round_, rs.step.name))
             await self._sign_add_vote(VoteType.PRECOMMIT, bid.hash,
                                 bid.part_set_header)
             return
@@ -544,13 +563,16 @@ class ConsensusState(Service):
             rs.locked_block_parts = rs.proposal_block_parts
             if self.event_bus is not None:
                 self.event_bus.publish_lock(EventDataRoundState(
-                    height, round_, "Lock"
+                    height, round_, rs.step.name
                 ))
             await self._sign_add_vote(VoteType.PRECOMMIT, bid.hash,
                                 bid.part_set_header)
             return
 
         # polka for a block we don't have: unlock, precommit nil, fetch
+        if rs.locked_block is not None and self.event_bus is not None:
+            self.event_bus.publish_unlock(EventDataRoundState(
+                height, round_, rs.step.name))  # state.go:1362
         rs.locked_round = -1
         rs.locked_block = None
         rs.locked_block_parts = None
@@ -1096,12 +1118,19 @@ class ConsensusState(Service):
                 rs.locked_round = -1
                 rs.locked_block = None
                 rs.locked_block_parts = None
+                if self.event_bus is not None:  # state.go:1987
+                    self.event_bus.publish_unlock(EventDataRoundState(
+                        rs.height, rs.round, rs.step.name))
             # track valid block (state.go:1984)
             if rs.valid_round < vote.round <= rs.round:
                 if rs.proposal_block is not None and rs.proposal_block.hash() == bid.hash:
                     rs.valid_round = vote.round
                     rs.valid_block = rs.proposal_block
                     rs.valid_block_parts = rs.proposal_block_parts
+                    if self.event_bus is not None:  # state.go:2013
+                        self.event_bus.publish_valid_block(
+                            EventDataRoundState(rs.height, rs.round,
+                                                rs.step.name))
                 elif rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
                     bid.part_set_header
                 ):

@@ -163,6 +163,10 @@ class Node(Service):
         from ..state.txindex import (BlockIndexer, IndexerService,
                                      TxIndexer)
 
+        # Reject unknown indexer values on EVERY construction path
+        # (CLI, e2e runner, embedders) — an unvalidated typo must not
+        # silently mean "kv".
+        cfg.tx_index.validate_basic()
         if cfg.tx_index.indexer == "null":
             # reference config/config.go:976: indexing disabled —
             # /tx, /tx_search, /block_search error out (rpc/core.py

@@ -691,10 +691,11 @@ class Environment:
 
         async def next_notification():
             msg = await sub.next()
+            ev_name = (msg.attrs.get("tm.event") or [None])[0]
             return {
                 "jsonrpc": "2.0", "id": None,
                 "result": {"query": query,
-                           "data": _event_json(msg.data),
+                           "data": _event_json(msg.data, ev_name),
                            "events": msg.attrs},
             }
 
@@ -732,14 +733,18 @@ class Environment:
             task.cancel()
 
 
-def _event_json(data) -> dict:
+def _event_json(data, event: str | None = None) -> dict:
+    """JSON form of an event payload. `event` is the tm.event name
+    from the pubsub attributes — round-state payloads share one
+    dataclass across many event types (TimeoutPropose, Unlock, ...),
+    so the name must come from the subscription, not the payload."""
     if isinstance(data, EventDataNewBlock):
         return {"type": "NewBlock", "block": _block_json(data.block)}
     if isinstance(data, EventDataTx):
         return {"type": "Tx", "height": str(data.height),
                 "index": data.index, "tx": _b64(data.tx),
                 "result": data.result}
-    out = {"type": type(data).__name__}
+    out = {"type": event or type(data).__name__}
     for k in ("height", "round", "step"):
         if hasattr(data, k):
             out[k] = getattr(data, k)

@@ -19,6 +19,11 @@ EVENT_NEW_ROUND_STEP = "NewRoundStep"
 EVENT_COMPLETE_PROPOSAL = "CompleteProposal"
 EVENT_POLKA = "Polka"
 EVENT_LOCK = "Lock"
+EVENT_RELOCK = "Relock"
+EVENT_UNLOCK = "Unlock"
+EVENT_VALID_BLOCK = "ValidBlock"
+EVENT_TIMEOUT_PROPOSE = "TimeoutPropose"
+EVENT_TIMEOUT_WAIT = "TimeoutWait"
 EVENT_VOTE = "Vote"
 EVENT_VALIDATOR_SET_UPDATES = "ValidatorSetUpdates"
 EVENT_NEW_EVIDENCE = "NewEvidence"
@@ -133,6 +138,21 @@ class EventBus:
 
     def publish_lock(self, data: EventDataRoundState):
         self._publish(EVENT_LOCK, data)
+
+    def publish_relock(self, data: EventDataRoundState):
+        self._publish(EVENT_RELOCK, data)
+
+    def publish_unlock(self, data: EventDataRoundState):
+        self._publish(EVENT_UNLOCK, data)
+
+    def publish_valid_block(self, data: EventDataRoundState):
+        self._publish(EVENT_VALID_BLOCK, data)
+
+    def publish_timeout_propose(self, data: EventDataRoundState):
+        self._publish(EVENT_TIMEOUT_PROPOSE, data)
+
+    def publish_timeout_wait(self, data: EventDataRoundState):
+        self._publish(EVENT_TIMEOUT_WAIT, data)
 
     def publish_new_evidence(self, data: EventDataNewEvidence):
         self._publish(EVENT_NEW_EVIDENCE, data)

@@ -296,3 +296,54 @@ def test_catchup_parts_complete_despite_stale_proposal(tmp_path):
             await node.stop()
 
     asyncio.run(go())
+
+
+def test_round_state_event_catalog_publishes():
+    """The full reference event catalog (types/events.go:28-38) is
+    publishable and routable by tm.event query — incl. the round-4
+    additions Relock/Unlock/ValidBlock/TimeoutPropose/TimeoutWait."""
+    async def go():
+        from tendermint_tpu.types.events import (EventDataRoundState,
+                                                 query_for_event)
+        bus = EventBus()
+        names = ["NewRoundStep", "NewRound", "CompleteProposal",
+                 "Polka", "Lock", "Relock", "Unlock", "ValidBlock",
+                 "TimeoutPropose", "TimeoutWait", "Vote"]
+        subs = {n: bus.subscribe(f"s-{n}", query_for_event(n))
+                for n in names if n != "Vote"}
+        for n, pub in [
+            ("NewRoundStep", bus.publish_new_round_step),
+            ("NewRound", bus.publish_new_round),
+            ("CompleteProposal", bus.publish_complete_proposal),
+            ("Polka", bus.publish_polka),
+            ("Lock", bus.publish_lock),
+            ("Relock", bus.publish_relock),
+            ("Unlock", bus.publish_unlock),
+            ("ValidBlock", bus.publish_valid_block),
+            ("TimeoutPropose", bus.publish_timeout_propose),
+            ("TimeoutWait", bus.publish_timeout_wait),
+        ]:
+            pub(EventDataRoundState(5, 1, n))
+            msg = await asyncio.wait_for(subs[n].next(), timeout=5)
+            assert msg.data.height == 5 and msg.data.step == n, n
+
+    asyncio.run(go())
+
+
+def test_timeout_propose_event_fires_when_proposer_absent(tmp_path):
+    """A 2-validator net with one validator offline: rounds where the
+    dead node is proposer hit the propose timeout, and the state
+    machine publishes TimeoutPropose (reference state.go:854)."""
+    async def go():
+        from tendermint_tpu.types.events import query_for_event
+        gdoc, pvs = make_genesis(2)
+        node = Node(gdoc, pvs[0], None)
+        await node.start()
+        sub = node.event_bus.subscribe("t", query_for_event("TimeoutPropose"))
+        try:
+            msg = await asyncio.wait_for(sub.next(), timeout=30)
+            assert msg.data.height >= 1
+        finally:
+            await node.stop()
+
+    asyncio.run(go())
