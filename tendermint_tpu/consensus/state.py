@@ -367,7 +367,7 @@ class ConsensusState(Service):
         rs.triggered_timeout_precommit = False
         if self.event_bus is not None:
             self.event_bus.publish_new_round(EventDataRoundState(
-                height, round_, "NewRound"
+                height, round_, rs.step.name
             ))
         await self._enter_propose(height, round_)
 

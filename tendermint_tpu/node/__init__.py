@@ -119,6 +119,12 @@ class Node(Service):
                  state_provider_factory=None,
                  in_memory: bool = False):
         super().__init__(name=f"node.{config.base.moniker}")
+        # Fail fast at construction — before any DB/socket/app-conn is
+        # acquired — on every construction path (CLI, e2e runner,
+        # embedders): an unvalidated typo (tx_index.indexer = "nulll",
+        # fastsync.version = "v3", ...) must not silently mean the
+        # default behavior, and must not leak half-started resources.
+        config.validate_basic()
         self.config = config
         self.genesis_doc = genesis_doc or GenesisDoc.load(
             config.base.resolve(config.base.genesis_file))
@@ -163,10 +169,6 @@ class Node(Service):
         from ..state.txindex import (BlockIndexer, IndexerService,
                                      TxIndexer)
 
-        # Reject unknown indexer values on EVERY construction path
-        # (CLI, e2e runner, embedders) — an unvalidated typo must not
-        # silently mean "kv".
-        cfg.tx_index.validate_basic()
         if cfg.tx_index.indexer == "null":
             # reference config/config.go:976: indexing disabled —
             # /tx, /tx_search, /block_search error out (rpc/core.py

@@ -76,3 +76,41 @@ def _remainders(n, chunks):
         out.append(n)
         n -= c
     return out
+
+
+def test_mixed_key_types_interleaved():
+    """BASELINE config #4: one batch interleaving ed25519, sr25519 and
+    secp256k1 lanes (the evidence-pool shape). The by-type grouping
+    must scatter per-lane verdicts back to their ORIGINAL positions,
+    with corrupt lanes of each type failing in place."""
+    from tendermint_tpu.crypto import sr25519_ref
+    from tendermint_tpu.crypto.secp256k1 import Secp256k1PrivKey
+    from tendermint_tpu.crypto.sr25519 import Sr25519PubKey
+
+    bv = BatchVerifier()
+    want = []
+    for i in range(12):
+        msg = b"mixed lane %d" % i
+        kind = i % 3
+        if kind == 0:
+            priv = ed25519.Ed25519PrivKey(
+                hashlib.sha256(b"mix-ed%d" % i).digest())
+            pk = priv.pub_key()
+            m, s = msg, priv.sign(msg)
+        elif kind == 1:
+            mini = hashlib.sha256(b"mix-sr%d" % i).digest()
+            pk = Sr25519PubKey(sr25519_ref.public_key_from_mini(mini))
+            m, s = msg, sr25519_ref.sign(mini, msg)
+        else:
+            priv = Secp256k1PrivKey(
+                hashlib.sha256(b"mix-sec%d" % i).digest())
+            pk = priv.pub_key()
+            m, s = msg, priv.sign(msg)
+        good = i not in (4, 5, 9)  # corrupt one lane of each type
+        if not good:
+            s = s[:8] + bytes([s[8] ^ 1]) + s[9:]
+        bv.add(pk, m, s)
+        want.append(good)
+    ok, lanes = bv.verify()
+    assert not ok
+    assert lanes.tolist() == want
