@@ -13,6 +13,11 @@ TOML schema:
     op = "kill"                  # kill | pause | disconnect | restart
     at_height = 3                # trigger when the net reaches this
     duration = 3.0               # pause/disconnect length (seconds)
+
+    [[validator_updates]]        # scheduled valset change
+    node = 3                     # whose power to change
+    at_height = 2                # submit the kvstore validator tx here
+    power = 3                    # new voting power (0 = remove)
 """
 
 from __future__ import annotations
@@ -36,6 +41,29 @@ class Perturbation:
             raise ValueError(f"perturbation node {self.node} out of range")
         if self.at_height < 1:
             raise ValueError("perturbation at_height must be >= 1")
+
+
+@dataclass
+class ValidatorUpdate:
+    """A scheduled validator-set change (reference: manifest.go
+    validator-set schedules): at `at_height`, submit a kvstore
+    validator tx changing node `node`'s voting power to `power`
+    (0 removes it from the set). Exercises the full valset-change
+    path in a live net: EndBlock updates -> update_with_change_set ->
+    proposer-priority rebuild -> device comb-table rewarm."""
+
+    node: int
+    at_height: int
+    power: int
+
+    def validate(self, n_nodes: int) -> None:
+        if not 0 <= self.node < n_nodes:
+            raise ValueError(f"validator_update node {self.node} "
+                             "out of range")
+        if self.at_height < 1:
+            raise ValueError("validator_update at_height must be >= 1")
+        if self.power < 0:
+            raise ValueError("validator_update power must be >= 0")
 
 
 @dataclass
@@ -67,6 +95,7 @@ class Manifest:
     timeout_commit_ms: int = 200
     perturbations: list[Perturbation] = field(default_factory=list)
     misbehaviors: list[Misbehavior] = field(default_factory=list)
+    validator_updates: list[ValidatorUpdate] = field(default_factory=list)
     # Hold the LAST node back; once the net has snapshots, start it
     # with state sync configured from a live trust hash and make it
     # catch up (reference manifest state_sync node role).
@@ -81,6 +110,14 @@ class Manifest:
             p.validate(self.nodes)
         for mb in self.misbehaviors:
             mb.validate(self.nodes)
+        for vu in self.validator_updates:
+            vu.validate(self.nodes)
+            # power takes effect at commit+2; the final valset check
+            # needs the change live by wait_height
+            if vu.at_height + 3 > self.wait_height:
+                raise ValueError(
+                    f"validator_update at {vu.at_height} cannot take "
+                    f"effect by wait_height {self.wait_height}")
 
     @classmethod
     def load(cls, path: str) -> "Manifest":
@@ -93,9 +130,10 @@ class Manifest:
     _KEYS = frozenset({"nodes", "chain_id", "wait_height",
                        "load_tx_rate", "timeout_commit_ms",
                        "perturbations", "misbehaviors",
-                       "late_statesync_node"})
+                       "validator_updates", "late_statesync_node"})
     _PERTURB_KEYS = frozenset({"node", "op", "at_height", "duration"})
     _MISBEHAVIOR_KEYS = frozenset({"node", "spec"})
+    _VALUPDATE_KEYS = frozenset({"node", "at_height", "power"})
 
     @classmethod
     def from_dict(cls, d: dict) -> "Manifest":
@@ -114,6 +152,11 @@ class Manifest:
             if bad:
                 raise ValueError(
                     f"unknown misbehavior keys: {sorted(bad)}")
+        for vu in d.get("validator_updates", []):
+            bad = set(vu) - cls._VALUPDATE_KEYS
+            if bad:
+                raise ValueError(
+                    f"unknown validator_update keys: {sorted(bad)}")
         m = cls(
             nodes=int(d.get("nodes", 4)),
             chain_id=d.get("chain_id", ""),
@@ -132,6 +175,12 @@ class Manifest:
             misbehaviors=[
                 Misbehavior(node=int(mb["node"]), spec=mb["spec"])
                 for mb in d.get("misbehaviors", [])
+            ],
+            validator_updates=[
+                ValidatorUpdate(node=int(vu["node"]),
+                                at_height=int(vu["at_height"]),
+                                power=int(vu["power"]))
+                for vu in d.get("validator_updates", [])
             ],
             late_statesync_node=bool(d.get("late_statesync_node", False)),
         )

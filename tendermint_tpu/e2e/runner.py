@@ -141,6 +141,8 @@ class Runner:
         self.log = log
         self._load_task = None
         self._txs_sent = 0
+        self._expected_powers: dict[str, int] = {}
+        self._valset_changes = 0
 
     # -- stages --
 
@@ -336,6 +338,74 @@ class Runner:
         else:  # pragma: no cover - manifest validated
             raise ValueError(p.op)
 
+    # -- validator-set schedule (reference manifest.go validator
+    # schedules; kvstore "val:<pub>!<power>" txs route through
+    # EndBlock -> update_with_change_set -> device-table rewarm) --
+
+    def _node_pub_hex(self, index: int) -> str:
+        import json as _json
+
+        key_path = os.path.join(self.out_dir, f"node{index}",
+                                "config", "priv_validator_key.json")
+        with open(key_path) as f:
+            return _json.load(f)["pub_key"]
+
+    async def apply_valupdate(self, vu) -> None:
+        import base64
+
+        from ..abci.kvstore import encode_validator_tx
+
+        pub_hex = self._node_pub_hex(vu.node)
+        tx = encode_validator_tx(pub_hex, vu.power)
+        self.log(f"valupdate: node{vu.node} power -> {vu.power} at net "
+                 f"height {await self.net_height()}")
+        # Submit to any LIVE node, preferring one other than the node
+        # being updated (it may be leaving the set); a co-scheduled
+        # perturbation or a held-back statesync node means a blind
+        # target can be down — retry around the ring like the load
+        # loop tolerates perturbed nodes.
+        last_err: Exception | None = None
+        for attempt in range(30):
+            target = self.nodes[(vu.node + 1 + attempt)
+                                % len(self.nodes)]
+            try:
+                res = await self._rpc(target, "broadcast_tx_sync",
+                                      tx=base64.b64encode(tx).decode())
+                assert int(res.get("code", 0)) == 0, \
+                    f"valupdate rejected: {res}"
+                break
+            except AssertionError:
+                raise
+            except Exception as e:  # node down/perturbed: try the next
+                last_err = e
+                await asyncio.sleep(0.5)
+        else:
+            raise RuntimeError(
+                f"no live node accepted the validator tx: {last_err}")
+        self._expected_powers[pub_hex.upper()] = vu.power
+        self._valset_changes += 1
+
+    async def check_valset(self) -> None:
+        """The final validator set reflects every scheduled update
+        (powers take effect at H+2; wait_height leaves room)."""
+        if not self._expected_powers:
+            return
+        vals = await self._rpc(self.nodes[0], "validators",
+                               per_page=100)
+        got = {v["pub_key"]["value"]: int(v["voting_power"])
+               for v in vals["validators"]}
+        import base64 as _b64
+
+        for pub_hex, power in self._expected_powers.items():
+            b64 = _b64.b64encode(bytes.fromhex(pub_hex)).decode()
+            if power == 0:
+                assert b64 not in got, f"validator {pub_hex[:12]} " \
+                    "still in set after power 0"
+            else:
+                assert got.get(b64) == power, (
+                    f"validator {pub_hex[:12]} power {got.get(b64)} "
+                    f"!= scheduled {power}")
+
     # -- the full run --
 
     async def run(self) -> dict:
@@ -343,16 +413,25 @@ class Runner:
             self.setup()
             self.start()
             self.start_load()
-            for p in sorted(self.m.perturbations,
-                            key=lambda p: p.at_height):
-                await self.wait_net_height(p.at_height)
-                await self.apply(p)
+            events = (
+                [(p.at_height, 0, p) for p in self.m.perturbations]
+                + [(vu.at_height, 1, vu)
+                   for vu in self.m.validator_updates]
+            )
+            for _, kind, ev in sorted(events, key=lambda e: e[:2]):
+                await self.wait_net_height(ev.at_height)
+                if kind == 0:
+                    await self.apply(ev)
+                else:
+                    await self.apply_valupdate(ev)
             if self.m.late_statesync_node:
                 await self.start_late_statesync_node()
             await self.wait_all_height(self.m.wait_height)
             self.stop_load()
+            await self.check_valset()
             report = await self.check()
             report["txs_sent"] = self._txs_sent
+            report["valset_changes"] = self._valset_changes
             return report
         finally:
             self.stop_load()

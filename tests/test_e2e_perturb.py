@@ -155,3 +155,43 @@ def test_late_statesync_node_joins(tmp_path):
                                "node.log"), "rb").read()
     assert b"state sync done at height" in n3_log, \
         n3_log[-2000:].decode(errors="replace")
+
+
+def test_validator_update_schedule(tmp_path):
+    """A scheduled validator-set change (reference manifest.go
+    validator schedules): node3's power drops 10 -> 3 mid-run via a
+    kvstore validator tx; the change is live in the final set, the net
+    keeps committing through the valset swap (EndBlock update ->
+    proposer-priority rebuild -> table rewarm), and nobody forks."""
+    m = Manifest.from_dict({
+        "chain_id": "valupd-chain",
+        "nodes": 4,
+        "wait_height": 8,
+        "load_tx_rate": 2.0,
+        "timeout_commit_ms": 150,
+        "validator_updates": [
+            {"node": 3, "at_height": 2, "power": 3},
+        ],
+    })
+    logs = []
+    runner = Runner(m, str(tmp_path / "net"), base_port=27700,
+                    log=lambda s: logs.append(s))
+    report = asyncio.run(asyncio.wait_for(runner.run(), timeout=3000))
+    assert report["ok"] and report["valset_changes"] == 1
+    assert any(ln.startswith("valupdate:") for ln in logs)
+
+
+def test_validator_update_manifest_validation():
+    import pytest
+
+    # change cannot take effect by wait_height
+    with pytest.raises(ValueError):
+        Manifest.from_dict({"nodes": 2, "wait_height": 4,
+                            "validator_updates": [
+                                {"node": 0, "at_height": 2, "power": 5}]})
+    # unknown key
+    with pytest.raises(ValueError):
+        Manifest.from_dict({"nodes": 2, "wait_height": 9,
+                            "validator_updates": [
+                                {"node": 0, "at_height": 2, "power": 5,
+                                 "bogus": 1}]})
