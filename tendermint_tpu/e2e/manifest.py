@@ -96,6 +96,12 @@ class Manifest:
     perturbations: list[Perturbation] = field(default_factory=list)
     misbehaviors: list[Misbehavior] = field(default_factory=list)
     validator_updates: list[ValidatorUpdate] = field(default_factory=list)
+    # ABCI transport (reference manifest.go ABCIProtocol matrix):
+    # "builtin" runs the kvstore in-process; "tcp" (varint-framed
+    # socket) and "grpc" run one app SERVER PROCESS per node, so node
+    # kill/restart perturbations exercise the handshake replay against
+    # a live external app.
+    abci: str = "builtin"
     # Hold the LAST node back; once the net has snapshots, start it
     # with state sync configured from a live trust hash and make it
     # catch up (reference manifest state_sync node role).
@@ -104,6 +110,17 @@ class Manifest:
     def validate(self) -> None:
         if self.nodes < 1:
             raise ValueError("need at least one node")
+        if self.abci not in ("builtin", "tcp", "grpc"):
+            raise ValueError(f"unknown abci transport {self.abci!r}")
+        if self.abci != "builtin":
+            # the external abci-cli kvstore is the plain in-memory app:
+            # no validator txs, no snapshots
+            if self.validator_updates:
+                raise ValueError(
+                    "validator_updates require abci = \"builtin\"")
+            if self.late_statesync_node:
+                raise ValueError(
+                    "late_statesync_node requires abci = \"builtin\"")
         if self.wait_height < 1:
             raise ValueError("wait_height must be >= 1")
         for p in self.perturbations:
@@ -130,7 +147,8 @@ class Manifest:
     _KEYS = frozenset({"nodes", "chain_id", "wait_height",
                        "load_tx_rate", "timeout_commit_ms",
                        "perturbations", "misbehaviors",
-                       "validator_updates", "late_statesync_node"})
+                       "validator_updates", "late_statesync_node",
+                       "abci"})
     _PERTURB_KEYS = frozenset({"node", "op", "at_height", "duration"})
     _MISBEHAVIOR_KEYS = frozenset({"node", "spec"})
     _VALUPDATE_KEYS = frozenset({"node", "at_height", "power"})
@@ -183,6 +201,7 @@ class Manifest:
                 for vu in d.get("validator_updates", [])
             ],
             late_statesync_node=bool(d.get("late_statesync_node", False)),
+            abci=d.get("abci", "builtin"),
         )
         m.validate()
         return m

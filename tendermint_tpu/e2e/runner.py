@@ -62,6 +62,63 @@ async def wait_progress(sample, done, *, timeout: float = 120.0,
         await asyncio.sleep(0.25)
 
 
+def _child_env() -> dict:
+    """Env for e2e child processes. FORCE cpu (not setdefault): e2e
+    nets are CPU-only by design — an inherited accelerator platform
+    var pointed soak nodes at the (wedged) TPU relay, freezing them on
+    their first big signature batch. The bench owns the real chip."""
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _terminate_proc(proc: subprocess.Popen | None, log_f,
+                    timeout: float = 30.0):
+    """SIGTERM -> wait -> SIGKILL, then close the log fd. Returns the
+    (now closed) log handle slot value (always None) for assignment."""
+    if proc is not None and proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+    if log_f is not None:
+        log_f.close()
+    return None
+
+
+class AppProc:
+    """An out-of-process ABCI app server (abci = "tcp" | "grpc"):
+    one kvstore server per node, so node perturbations exercise the
+    handshake replay against a live external app — the reference e2e
+    matrix's ABCIProtocol dimension."""
+
+    def __init__(self, index: int, home: str, port: int, abci: str):
+        self.index = index
+        self.port = port
+        self.abci = abci  # "socket" | "grpc" (abci-cli values)
+        self.log_path = os.path.join(home, "app.log")
+        self.proc: subprocess.Popen | None = None
+        self._log_f = None
+
+    def start(self) -> None:
+        self._log_f = open(self.log_path, "ab")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu.abci.cli", "kvstore",
+             "--address", f"tcp://127.0.0.1:{self.port}",
+             "--abci", self.abci],
+            stdout=self._log_f, stderr=subprocess.STDOUT,
+            env=_child_env())
+
+    def terminate(self) -> None:
+        self._log_f = _terminate_proc(self.proc, self._log_f,
+                                      timeout=10.0)
+
+
 class NodeProc:
     def __init__(self, index: int, home: str, rpc_port: int,
                  misbehavior: str = ""):
@@ -75,15 +132,7 @@ class NodeProc:
 
     def start(self) -> None:
         assert self.proc is None or self.proc.poll() is not None
-        env = dict(os.environ)
-        repo_root = os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))))
-        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-        # FORCE cpu (not setdefault): e2e nets are CPU-only by design —
-        # an inherited accelerator platform var pointed soak nodes at
-        # the (wedged) TPU relay, freezing them on their first big
-        # signature batch. The bench owns the real chip.
-        env["JAX_PLATFORMS"] = "cpu"
+        env = _child_env()
         cmd = [sys.executable, "-m", "tendermint_tpu.cmd",
                "--home", self.home, "start"]
         if os.environ.get("TM_E2E_DEBUG"):
@@ -119,16 +168,8 @@ class NodeProc:
         os.kill(self.pid, signal.SIGCONT)
 
     def terminate(self, timeout: float = 10.0) -> None:
-        if self.alive():
-            self.proc.terminate()
-            try:
-                self.proc.wait(timeout)
-            except subprocess.TimeoutExpired:
-                self.proc.kill()
-                self.proc.wait()
-        if self._log_f is not None:
-            self._log_f.close()
-            self._log_f = None
+        self._log_f = _terminate_proc(self.proc, self._log_f,
+                                      timeout=timeout)
 
 
 class Runner:
@@ -143,6 +184,7 @@ class Runner:
         self._txs_sent = 0
         self._expected_powers: dict[str, int] = {}
         self._valset_changes = 0
+        self.apps: list[AppProc] = []
 
     # -- stages --
 
@@ -172,6 +214,14 @@ class Runner:
             # immediately.
             cfg.base.fast_sync = True
             cfg.consensus.timeout_commit_ms = self.m.timeout_commit_ms
+            if self.m.abci != "builtin":
+                app_port = self.base_port + 2000 + i
+                cfg.base.proxy_app = f"127.0.0.1:{app_port}"
+                cfg.base.abci = ("grpc" if self.m.abci == "grpc"
+                                 else "socket")
+                self.apps.append(AppProc(
+                    i, home, app_port,
+                    "grpc" if self.m.abci == "grpc" else "socket"))
             if self.m.late_statesync_node:
                 # servers take snapshots; the late joiner fast-syncs
                 # its tail after the snapshot restore
@@ -183,6 +233,11 @@ class Runner:
                 i, home, self.base_port + 1000 + i, misbehavior=mb))
 
     def start(self) -> None:
+        for app in self.apps:  # app servers first: nodes dial them
+            app.start()
+        if self.apps:
+            self.log(f"started {len(self.apps)} external "
+                     f"{self.m.abci} ABCI app servers")
         held_back = (
             {self.m.nodes - 1} if self.m.late_statesync_node else set())
         started = [n for n in self.nodes if n.index not in held_back]
@@ -376,8 +431,14 @@ class Runner:
                 break
             except AssertionError:
                 raise
-            except Exception as e:  # node down/perturbed: try the next
-                last_err = e
+            except Exception as e:
+                # "already in cache" means the tx IS in the mempool —
+                # a lost response on a successful broadcast, or a
+                # prior attempt that gossiped before its node dropped.
+                # That is success, not a dead node.
+                if "already in cache" in str(e):
+                    break
+                last_err = e  # node down/perturbed: try the next
                 await asyncio.sleep(0.5)
         else:
             raise RuntimeError(
@@ -386,25 +447,35 @@ class Runner:
         self._valset_changes += 1
 
     async def check_valset(self) -> None:
-        """The final validator set reflects every scheduled update
-        (powers take effect at H+2; wait_height leaves room)."""
+        """The final validator set reflects every scheduled update.
+        Powers take effect at H_include+2 and inclusion can lag a
+        co-scheduled perturbation's retries while the net keeps
+        committing, so poll (bounded) instead of asserting one
+        latest-height snapshot."""
         if not self._expected_powers:
             return
-        vals = await self._rpc(self.nodes[0], "validators",
-                               per_page=100)
-        got = {v["pub_key"]["value"]: int(v["voting_power"])
-               for v in vals["validators"]}
         import base64 as _b64
 
-        for pub_hex, power in self._expected_powers.items():
-            b64 = _b64.b64encode(bytes.fromhex(pub_hex)).decode()
-            if power == 0:
-                assert b64 not in got, f"validator {pub_hex[:12]} " \
-                    "still in set after power 0"
-            else:
-                assert got.get(b64) == power, (
-                    f"validator {pub_hex[:12]} power {got.get(b64)} "
-                    f"!= scheduled {power}")
+        deadline = asyncio.get_running_loop().time() + 30.0
+        mismatch = "unchecked"
+        while True:
+            vals = await self._rpc(self.nodes[0], "validators",
+                                   per_page=100)
+            got = {v["pub_key"]["value"]: int(v["voting_power"])
+                   for v in vals["validators"]}
+            mismatch = None
+            for pub_hex, power in self._expected_powers.items():
+                b64 = _b64.b64encode(bytes.fromhex(pub_hex)).decode()
+                if (power == 0 and b64 in got) or (
+                        power != 0 and got.get(b64) != power):
+                    mismatch = (f"validator {pub_hex[:12]} power "
+                                f"{got.get(b64)} != scheduled {power}")
+                    break
+            if mismatch is None:
+                return
+            if asyncio.get_running_loop().time() > deadline:
+                raise AssertionError(mismatch)
+            await asyncio.sleep(0.5)
 
     # -- the full run --
 
@@ -469,6 +540,8 @@ class Runner:
             except Exception:
                 pass
             node.terminate()
+        for app in self.apps:
+            app.terminate()
 
 
 def main(argv=None) -> int:

@@ -195,3 +195,59 @@ def test_validator_update_manifest_validation():
                             "validator_updates": [
                                 {"node": 0, "at_height": 2, "power": 5,
                                  "bogus": 1}]})
+
+
+def test_out_of_process_abci_tcp(tmp_path):
+    """The reference e2e matrix's ABCIProtocol dimension: each node
+    talks varint-framed socket ABCI to its own external kvstore app
+    process. kill -9 of a NODE (the app survives) forces handshake
+    replay against the live external app on restart."""
+    m = Manifest.from_dict({
+        "chain_id": "abci-tcp-chain",
+        "nodes": 3,
+        "wait_height": 6,
+        "load_tx_rate": 2.0,
+        "timeout_commit_ms": 150,
+        "abci": "tcp",
+        "perturbations": [
+            {"node": 1, "op": "kill", "at_height": 3},
+        ],
+    })
+    runner = Runner(m, str(tmp_path / "net"), base_port=27800,
+                    log=lambda s: None)
+    report = asyncio.run(asyncio.wait_for(runner.run(), timeout=3000))
+    assert report["ok"] and report["nodes"] == 3
+    # the app servers really ran out of process
+    for i in range(3):
+        log = open(os.path.join(str(tmp_path / "net"), f"node{i}",
+                                "app.log")).read()
+        assert "serving KVStoreApp abci=socket" in log
+
+
+def test_out_of_process_abci_grpc(tmp_path):
+    m = Manifest.from_dict({
+        "chain_id": "abci-grpc-chain",
+        "nodes": 2,
+        "wait_height": 4,
+        "timeout_commit_ms": 150,
+        "abci": "grpc",
+    })
+    runner = Runner(m, str(tmp_path / "net"), base_port=27900,
+                    log=lambda s: None)
+    report = asyncio.run(asyncio.wait_for(runner.run(), timeout=3000))
+    assert report["ok"] and report["nodes"] == 2
+    log = open(os.path.join(str(tmp_path / "net"), "node0",
+                            "app.log")).read()
+    assert "abci=grpc" in log
+
+
+def test_abci_manifest_validation():
+    import pytest
+
+    with pytest.raises(ValueError):
+        Manifest.from_dict({"nodes": 2, "abci": "udp"})
+    with pytest.raises(ValueError):
+        Manifest.from_dict({
+            "nodes": 2, "wait_height": 9, "abci": "tcp",
+            "validator_updates": [
+                {"node": 0, "at_height": 2, "power": 5}]})
