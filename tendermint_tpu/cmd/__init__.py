@@ -364,6 +364,60 @@ def cmd_unsafe_reset_priv_validator(args) -> int:
     return 0
 
 
+def cmd_signer(args) -> int:
+    """Remote-signer sidecar (the tmkms role; reference privval/
+    signer_server.go + SignerDialerEndpoint): load this home's file
+    key and DIAL the validator node's priv_validator_laddr, answering
+    sign requests. Reconnects forever — the signer outliving node
+    restarts is the point of running it out of process."""
+    import asyncio as _asyncio
+
+    from ..libs.net import split_laddr
+    from ..p2p.key import NodeKey
+    from ..privval import FilePV
+    from ..privval.signer import SignerServer
+    from ..types.genesis import GenesisDoc
+
+    cfg = _load_config(args.home)
+    pv = FilePV.load_or_generate(
+        cfg.base.resolve(cfg.base.priv_validator_key_file),
+        cfg.base.resolve(cfg.base.priv_validator_state_file))
+    chain_id = args.chain_id
+    if not chain_id:
+        chain_id = GenesisDoc.load(
+            cfg.base.resolve(cfg.base.genesis_file)).chain_id
+    host, port = split_laddr(args.connect, default_host="127.0.0.1")
+    # SecretConnection identity for the link (matches the node side,
+    # which keys the handshake on ITS node key): never plaintext TCP.
+    conn_key = NodeKey.load_or_gen(
+        cfg.base.resolve(cfg.base.node_key_file)).priv_key
+    server = SignerServer(pv, chain_id, conn_key=conn_key)
+    print(f"signer for validator "
+          f"{pv.get_pub_key().address().hex()[:12]}… dialing "
+          f"{host}:{port}", flush=True)
+
+    async def run():
+        while True:
+            try:
+                reader, writer = await _asyncio.open_connection(
+                    host, port)
+                print("connected to validator", flush=True)
+                await server.serve_connection(reader, writer)
+                print("validator link closed; redialing", flush=True)
+            except Exception as e:  # any wire error: log, back off,
+                print(f"signer link error: {e!r}", flush=True)  # redial
+            # unconditional backoff: a node that instantly closes the
+            # connection (e.g. it already has a live signer) must not
+            # turn this loop into a CPU spin
+            await _asyncio.sleep(1.0)
+
+    try:
+        _asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_gen_validator(args) -> int:
     from ..privval import FilePV
 
@@ -500,6 +554,18 @@ def build_parser() -> argparse.ArgumentParser:
                         help="reset only this node's validator to "
                              "genesis state (wipes last-sign state)")
     sp.set_defaults(fn=cmd_unsafe_reset_priv_validator)
+
+    sp = sub.add_parser("signer",
+                        help="remote-signer sidecar: dial a "
+                             "validator's priv_validator_laddr and "
+                             "answer sign requests with this home's "
+                             "file key")
+    sp.add_argument("--connect", required=True,
+                    help="validator's priv_validator_laddr, e.g. "
+                         "tcp://127.0.0.1:26659")
+    sp.add_argument("--chain-id", default="",
+                    help="chain id (default: from this home's genesis)")
+    sp.set_defaults(fn=cmd_signer)
 
     from .debug import register as register_debug
 

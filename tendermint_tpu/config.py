@@ -24,6 +24,12 @@ class BaseConfig:
     genesis_file: str = "config/genesis.json"
     priv_validator_key_file: str = "config/priv_validator_key.json"
     priv_validator_state_file: str = "data/priv_validator_state.json"
+    # When set (e.g. "tcp://127.0.0.1:26659"), the node LISTENS here
+    # for a remote signer to dial in and uses it instead of the file
+    # key (reference: config.go PrivValidatorListenAddr, wired at
+    # node.go:663). Run the sidecar: `tendermint-tpu signer
+    # --connect <this addr>`.
+    priv_validator_laddr: str = ""
     node_key_file: str = "config/node_key.json"
     abci: str = "builtin"  # builtin | socket | grpc
     proxy_app: str = "kvstore"

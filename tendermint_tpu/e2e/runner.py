@@ -457,7 +457,6 @@ class Runner:
         import base64 as _b64
 
         deadline = asyncio.get_running_loop().time() + 30.0
-        mismatch = "unchecked"
         while True:
             vals = await self._rpc(self.nodes[0], "validators",
                                    per_page=100)

@@ -142,7 +142,11 @@ class Node(Service):
     @classmethod
     def default_new_node(cls, config: Config) -> "Node":
         """reference: node/node.go:88 DefaultNewNode — file-backed
-        keys + builtin app."""
+        keys + builtin app; with priv_validator_laddr set, the signer
+        is REMOTE (a SignerClient built during _build) and no file key
+        is loaded here (node.go:663)."""
+        if config.base.priv_validator_laddr:
+            return cls(config)
         pv = FilePV.load_or_generate(
             config.base.resolve(config.base.priv_validator_key_file),
             config.base.resolve(config.base.priv_validator_state_file))
@@ -199,6 +203,35 @@ class Node(Service):
             wal=None if self.in_memory else WAL(wal_path),
             event_bus=self.event_bus)
         self.consensus_state.misbehaviors.update(self.misbehaviors)
+        if (self.priv_validator is None
+                and cfg.base.priv_validator_laddr):
+            # Remote signer (reference node.go:663): listen on the
+            # configured addr and wait until the signer dials in — a
+            # validator must not enter consensus without its key, and
+            # the reference listener waits indefinitely (a slow HSM
+            # box must not crash node startup). The link runs the
+            # SecretConnection STS handshake keyed on this node's
+            # node key — never plaintext over TCP.
+            from ..privval.signer import RemoteSignError, SignerClient
+
+            host, port = _split_laddr(cfg.base.priv_validator_laddr,
+                                      default_host="127.0.0.1")
+            sc = SignerClient(self.genesis_doc.chain_id, timeout=30.0,
+                              conn_key=self.node_key.priv_key)
+            bound = await sc.listen(host, port)
+            while True:
+                logger.info("waiting for remote signer on %s:%s",
+                            host, bound)
+                try:
+                    await sc.wait_connected()
+                    break
+                except (asyncio.TimeoutError, RemoteSignError) as e:
+                    logger.warning("remote signer not ready (%s); "
+                                   "still waiting", e)
+            logger.info("remote signer connected (validator %s)",
+                        sc.get_pub_key().address().hex()[:12])
+            self.priv_validator = sc
+            self._signer_client = sc
         if self.priv_validator is not None:
             self.consensus_state.set_priv_validator(self.priv_validator)
 
@@ -438,6 +471,8 @@ class Node(Service):
                 await self.stop()
 
     async def on_stop(self) -> None:
+        if getattr(self, "_signer_client", None) is not None:
+            self._signer_client.close()  # listener socket + link
         if self.rpc_server is not None:
             self.rpc_server.close()
         if getattr(self, "grpc_server", None) is not None:

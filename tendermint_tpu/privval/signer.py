@@ -208,57 +208,94 @@ class SignerClient:
     # -- connection management --
 
     async def listen(self, host: str = "127.0.0.1", port: int = 0):
-        """Listener mode: wait for the signer process to dial us
-        (reference: SignerListenerEndpoint)."""
-        connected = asyncio.get_running_loop().create_future()
+        """Listener mode: accept the signer process dialing us
+        (reference: SignerListenerEndpoint). The listener stays open
+        for the client's lifetime so a restarted/reconnecting signer
+        is picked back up on the next sign call — a validator must
+        not go permanently mute because one TCP link dropped."""
+        self._conn_q: asyncio.Queue = asyncio.Queue(maxsize=2)
 
         def on_conn(reader, writer):
-            if not connected.done():
-                connected.set_result((reader, writer))
-            else:
+            try:
+                self._conn_q.put_nowait((reader, writer))
+            except asyncio.QueueFull:
                 writer.close()
 
         server = await asyncio.start_server(on_conn, host, port)
         self._server = server
-        self._connected = connected
         return server.sockets[0].getsockname()[1]
 
-    async def wait_connected(self) -> None:
-        reader, writer = await asyncio.wait_for(self._connected, self.timeout)
-        self._link = await asyncio.wait_for(
+    async def _adopt(self, reader, writer) -> None:
+        """Establish a link on a fresh connection and verify the key
+        behind it. On RE-connection the signer must present the SAME
+        validator key — a different dialer cannot take over."""
+        from ..crypto.ed25519 import Ed25519PubKey
+
+        link = await asyncio.wait_for(
             _Link.establish(reader, writer, self.conn_key,
                             self.expected_signer_addr),
             self.timeout,
         )
-        # cache the pub key eagerly: get_pub_key must stay sync for the
-        # PrivValidator interface
-        await self._fetch_pub_key()
+        try:
+            await link.send({"type": "pub_key"})
+            resp = await asyncio.wait_for(link.recv(), self.timeout)
+            pk = Ed25519PubKey(bytes.fromhex(resp["pub_key"]))
+        except Exception:
+            link.close()
+            raise
+        if self._pub_key is not None and pk.bytes() != self._pub_key.bytes():
+            link.close()
+            raise RemoteSignError(
+                "reconnected signer presented a DIFFERENT validator key")
+        self._pub_key = pk
+        self._link = link
+
+    async def wait_connected(self) -> None:
+        reader, writer = await asyncio.wait_for(self._conn_q.get(),
+                                                self.timeout)
+        await self._adopt(reader, writer)
 
     async def connect(self, reader, writer) -> None:
         """Direct wiring (tests)."""
-        self._link = await _Link.establish(
-            reader, writer, self.conn_key, self.expected_signer_addr
-        )
-        await self._fetch_pub_key()
-
-    async def _fetch_pub_key(self) -> None:
-        resp = await self._call({"type": "pub_key"})
-        from ..crypto.ed25519 import Ed25519PubKey
-
-        self._pub_key = Ed25519PubKey(bytes.fromhex(resp["pub_key"]))
+        await self._adopt(reader, writer)
 
     def close(self) -> None:
-        if self._link is not None:
-            self._link.close()
+        self._drop_link()
         if getattr(self, "_server", None) is not None:
             self._server.close()
+            self._server = None
+
+    def _drop_link(self) -> None:
+        if self._link is not None:
+            try:
+                self._link.close()
+            except Exception:
+                pass
+            self._link = None
 
     async def _call(self, req: dict) -> dict:
-        if self._link is None:
-            raise RemoteSignError("signer not connected")
         async with self._lock:
-            await self._link.send(req)
-            resp = await asyncio.wait_for(self._link.recv(), self.timeout)
+            if self._link is None:
+                # a reconnected signer may be waiting in the accept
+                # queue (listener mode) — adopt it now
+                q = getattr(self, "_conn_q", None)
+                if q is None:
+                    raise RemoteSignError("signer not connected")
+                try:
+                    reader, writer = q.get_nowait()
+                except asyncio.QueueEmpty:
+                    raise RemoteSignError("signer not connected")
+                await self._adopt(reader, writer)
+            try:
+                await self._link.send(req)
+                resp = await asyncio.wait_for(self._link.recv(),
+                                              self.timeout)
+            except (ConnectionError, asyncio.IncompleteReadError,
+                    asyncio.TimeoutError, OSError, EOFError) as e:
+                # dead link: drop it so the next call adopts the
+                # signer's redial instead of failing forever
+                self._drop_link()
+                raise RemoteSignError(f"signer link lost: {e!r}")
         if resp.get("type") == "error":
             raise RemoteSignError(resp.get("error", "unknown"))
         return resp

@@ -192,3 +192,65 @@ def test_null_tx_indexer_disables_search(tmp_path):
             await node.stop()
 
     run(go())
+
+
+def test_remote_signer_node(tmp_path):
+    """priv_validator_laddr (reference node.go:663): a node with NO
+    local key listens for a remote signer; a sidecar dials in with the
+    validator key and the solo-validator net produces blocks — only
+    possible if every proposal+vote round-trips through the signer."""
+
+    async def go():
+        import socket
+
+        from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+        from tendermint_tpu.privval.signer import SignerServer
+
+        gdoc, pvs = single_val_genesis()
+        cfg = make_home(tmp_path, "rsig", gdoc)
+        # validator key lives ONLY in the signer, not the node home
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        cfg.base.priv_validator_laddr = f"tcp://127.0.0.1:{port}"
+
+        # SecretConnection both ways (the node keys on its node key)
+        signer = SignerServer(pvs[0], gdoc.chain_id,
+                              conn_key=Ed25519PrivKey.generate())
+
+        async def dial_and_serve():
+            for _ in range(200):
+                try:
+                    reader, writer = await asyncio.open_connection(
+                        "127.0.0.1", port)
+                    break
+                except OSError:
+                    await asyncio.sleep(0.05)
+            else:
+                raise AssertionError("node never listened for signer")
+            await signer.serve_connection(reader, writer)
+
+        loop = asyncio.get_running_loop()
+        sidecar = loop.create_task(dial_and_serve())
+        node = Node.default_new_node(cfg)
+        assert node.priv_validator is None  # no local key loaded
+        await node.start()
+        try:
+            from tendermint_tpu.privval.signer import SignerClient
+
+            assert isinstance(node.priv_validator, SignerClient)
+            await node.consensus_state.wait_for_height(3, timeout=60)
+            # Link drop + signer redial: the validator must resume
+            # signing on the replacement connection, not go mute.
+            node.priv_validator._drop_link()
+            sidecar.cancel()
+            sidecar2 = loop.create_task(dial_and_serve())
+            h = node.consensus_state.rs.height
+            await node.consensus_state.wait_for_height(h + 2,
+                                                       timeout=60)
+            sidecar2.cancel()
+        finally:
+            await node.stop()
+            sidecar.cancel()
+
+    run(go())
