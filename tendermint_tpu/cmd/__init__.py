@@ -395,24 +395,10 @@ def cmd_signer(args) -> int:
     print(f"signer for validator "
           f"{pv.get_pub_key().address().hex()[:12]}… dialing "
           f"{host}:{port}", flush=True)
-
-    async def run():
-        while True:
-            try:
-                reader, writer = await _asyncio.open_connection(
-                    host, port)
-                print("connected to validator", flush=True)
-                await server.serve_connection(reader, writer)
-                print("validator link closed; redialing", flush=True)
-            except Exception as e:  # any wire error: log, back off,
-                print(f"signer link error: {e!r}", flush=True)  # redial
-            # unconditional backoff: a node that instantly closes the
-            # connection (e.g. it already has a live signer) must not
-            # turn this loop into a CPU spin
-            await _asyncio.sleep(1.0)
-
     try:
-        _asyncio.run(run())
+        _asyncio.run(server.dial_and_serve(
+            host, port, retries=None, retry_delay=1.0,
+            on_event=lambda msg: print(msg, flush=True)))
     except KeyboardInterrupt:
         pass
     return 0

@@ -102,6 +102,12 @@ class Manifest:
     # kill/restart perturbations exercise the handshake replay against
     # a live external app.
     abci: str = "builtin"
+    # Privval mode (reference manifest.go PrivvalProtocol): "file"
+    # keeps keys in the node homes; "tcp" moves every validator key
+    # into a SIGNER SIDECAR PROCESS that dials its node's
+    # priv_validator_laddr over SecretConnection — perturbations then
+    # exercise consensus against out-of-process signing.
+    privval: str = "file"
     # Hold the LAST node back; once the net has snapshots, start it
     # with state sync configured from a live trust hash and make it
     # catch up (reference manifest state_sync node role).
@@ -112,6 +118,12 @@ class Manifest:
             raise ValueError("need at least one node")
         if self.abci not in ("builtin", "tcp", "grpc"):
             raise ValueError(f"unknown abci transport {self.abci!r}")
+        if self.privval not in ("file", "tcp"):
+            raise ValueError(f"unknown privval mode {self.privval!r}")
+        if self.privval == "tcp" and self.misbehaviors:
+            # maverick equivocation signs with a raw local key, which
+            # a remote-signer node deliberately does not have
+            raise ValueError("misbehaviors require privval = \"file\"")
         if self.abci != "builtin":
             # the external abci-cli kvstore is the plain in-memory app:
             # no validator txs, no snapshots
@@ -148,7 +160,7 @@ class Manifest:
                        "load_tx_rate", "timeout_commit_ms",
                        "perturbations", "misbehaviors",
                        "validator_updates", "late_statesync_node",
-                       "abci"})
+                       "abci", "privval"})
     _PERTURB_KEYS = frozenset({"node", "op", "at_height", "duration"})
     _MISBEHAVIOR_KEYS = frozenset({"node", "spec"})
     _VALUPDATE_KEYS = frozenset({"node", "at_height", "power"})
@@ -202,6 +214,7 @@ class Manifest:
             ],
             late_statesync_node=bool(d.get("late_statesync_node", False)),
             abci=d.get("abci", "builtin"),
+            privval=d.get("privval", "file"),
         )
         m.validate()
         return m

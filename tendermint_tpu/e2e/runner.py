@@ -119,6 +119,33 @@ class AppProc:
                                       timeout=10.0)
 
 
+class SignerProc:
+    """A remote-signer sidecar process (privval = "tcp"): holds the
+    validator key OUT of the node home and dials the node's
+    priv_validator_laddr over SecretConnection — the reference e2e
+    matrix's PrivvalProtocol dimension."""
+
+    def __init__(self, index: int, home: str, connect: str):
+        self.index = index
+        self.home = home
+        self.connect = connect
+        self.log_path = os.path.join(home, "signer.log")
+        self.proc: subprocess.Popen | None = None
+        self._log_f = None
+
+    def start(self) -> None:
+        self._log_f = open(self.log_path, "ab")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu.cmd",
+             "--home", self.home, "signer", "--connect", self.connect],
+            stdout=self._log_f, stderr=subprocess.STDOUT,
+            env=_child_env())
+
+    def terminate(self) -> None:
+        self._log_f = _terminate_proc(self.proc, self._log_f,
+                                      timeout=10.0)
+
+
 class NodeProc:
     def __init__(self, index: int, home: str, rpc_port: int,
                  misbehavior: str = ""):
@@ -185,6 +212,7 @@ class Runner:
         self._expected_powers: dict[str, int] = {}
         self._valset_changes = 0
         self.apps: list[AppProc] = []
+        self.signers: list[SignerProc] = []
 
     # -- stages --
 
@@ -222,6 +250,26 @@ class Runner:
                 self.apps.append(AppProc(
                     i, home, app_port,
                     "grpc" if self.m.abci == "grpc" else "socket"))
+            if self.m.privval == "tcp":
+                # move the validator key OUT of the node home into a
+                # signer-sidecar home; the node listens for the signer
+                signer_home = os.path.join(self.out_dir, f"signer{i}")
+                os.makedirs(os.path.join(signer_home, "config"))
+                os.makedirs(os.path.join(signer_home, "data"))
+                os.replace(
+                    os.path.join(home, "config",
+                                 "priv_validator_key.json"),
+                    os.path.join(signer_home, "config",
+                                 "priv_validator_key.json"))
+                shutil.copy(
+                    os.path.join(home, "config", "genesis.json"),
+                    os.path.join(signer_home, "config",
+                                 "genesis.json"))
+                pv_port = self.base_port + 3000 + i
+                cfg.base.priv_validator_laddr = \
+                    f"tcp://127.0.0.1:{pv_port}"
+                self.signers.append(SignerProc(
+                    i, signer_home, f"tcp://127.0.0.1:{pv_port}"))
             if self.m.late_statesync_node:
                 # servers take snapshots; the late joiner fast-syncs
                 # its tail after the snapshot restore
@@ -238,6 +286,11 @@ class Runner:
         if self.apps:
             self.log(f"started {len(self.apps)} external "
                      f"{self.m.abci} ABCI app servers")
+        for signer in self.signers:  # sidecars redial until node is up
+            signer.start()
+        if self.signers:
+            self.log(f"started {len(self.signers)} remote-signer "
+                     "sidecars")
         held_back = (
             {self.m.nodes - 1} if self.m.late_statesync_node else set())
         started = [n for n in self.nodes if n.index not in held_back]
@@ -402,6 +455,10 @@ class Runner:
 
         key_path = os.path.join(self.out_dir, f"node{index}",
                                 "config", "priv_validator_key.json")
+        if not os.path.exists(key_path):  # privval=tcp: key moved to
+            key_path = os.path.join(      # the signer sidecar home
+                self.out_dir, f"signer{index}", "config",
+                "priv_validator_key.json")
         with open(key_path) as f:
             return _json.load(f)["pub_key"]
 
@@ -541,6 +598,8 @@ class Runner:
             node.terminate()
         for app in self.apps:
             app.terminate()
+        for signer in self.signers:
+            signer.terminate()
 
 
 def main(argv=None) -> int:

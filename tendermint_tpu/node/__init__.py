@@ -212,7 +212,7 @@ class Node(Service):
             # box must not crash node startup). The link runs the
             # SecretConnection STS handshake keyed on this node's
             # node key — never plaintext over TCP.
-            from ..privval.signer import RemoteSignError, SignerClient
+            from ..privval.signer import SignerClient
 
             host, port = _split_laddr(cfg.base.priv_validator_laddr,
                                       default_host="127.0.0.1")
@@ -225,8 +225,13 @@ class Node(Service):
                 try:
                     await sc.wait_connected()
                     break
-                except (asyncio.TimeoutError, RemoteSignError) as e:
-                    logger.warning("remote signer not ready (%s); "
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    # ANY stray connection (port scanner, handshake
+                    # garbage, wrong key) must not crash startup —
+                    # keep waiting for the real signer.
+                    logger.warning("remote signer not ready (%r); "
                                    "still waiting", e)
             logger.info("remote signer connected (validator %s)",
                         sc.get_pub_key().address().hex()[:12])

@@ -162,17 +162,35 @@ class SignerServer:
             return {"type": "error", "error": f"internal: {e}"}
 
     async def dial_and_serve(self, host: str, port: int,
-                             retries: int = 10,
-                             retry_delay: float = 0.5) -> None:
+                             retries: int | None = 10,
+                             retry_delay: float = 0.5,
+                             on_event=None) -> None:
         """Dialer mode: connect OUT to the validator node
-        (reference: privval/socket_dialers.go)."""
-        for attempt in range(retries):
+        (reference: privval/socket_dialers.go). retries=None redials
+        FOREVER with a bounded backoff — the sidecar deployment shape
+        (`tendermint-tpu signer`), where outliving node restarts and
+        shrugging off wire garbage is the point. Any wire error is
+        backed off, never a tight loop; `on_event(msg)` reports
+        connects/drops to the caller (the CLI prints them)."""
+        attempt = 0
+        while retries is None or attempt < retries:
+            attempt += 1
             try:
                 reader, writer = await asyncio.open_connection(host, port)
+                if on_event:
+                    on_event("connected to validator")
                 await self.serve_connection(reader, writer)
-                return
+                if retries is not None:
+                    return
+                if on_event:
+                    on_event("validator link closed; redialing")
             except ConnectionError:
-                await asyncio.sleep(retry_delay * (attempt + 1))
+                pass
+            except Exception as e:  # garbage frames, handshake noise
+                if on_event:
+                    on_event(f"signer link error: {e!r}")
+            await asyncio.sleep(min(retry_delay * attempt, 2.0)
+                                if retries is not None else retry_delay)
         raise ConnectionError(f"signer could not reach {host}:{port}")
 
 
@@ -204,6 +222,8 @@ class SignerClient:
         self._link: _Link | None = None
         self._lock = asyncio.Lock()
         self._pub_key = None
+        self._conn_q: asyncio.Queue | None = None
+        self._server = None
 
     # -- connection management --
 
@@ -239,10 +259,17 @@ class SignerClient:
         try:
             await link.send({"type": "pub_key"})
             resp = await asyncio.wait_for(link.recv(), self.timeout)
+            if resp.get("type") == "error" or "pub_key" not in resp:
+                raise RemoteSignError(
+                    f"signer pub_key exchange failed: {resp!r:.200}")
             pk = Ed25519PubKey(bytes.fromhex(resp["pub_key"]))
-        except Exception:
+        except RemoteSignError:
             link.close()
             raise
+        except Exception as e:
+            link.close()
+            raise RemoteSignError(
+                f"signer pub_key exchange failed: {e!r}")
         if self._pub_key is not None and pk.bytes() != self._pub_key.bytes():
             link.close()
             raise RemoteSignError(
@@ -261,7 +288,7 @@ class SignerClient:
 
     def close(self) -> None:
         self._drop_link()
-        if getattr(self, "_server", None) is not None:
+        if self._server is not None:
             self._server.close()
             self._server = None
 
@@ -278,11 +305,10 @@ class SignerClient:
             if self._link is None:
                 # a reconnected signer may be waiting in the accept
                 # queue (listener mode) — adopt it now
-                q = getattr(self, "_conn_q", None)
-                if q is None:
+                if self._conn_q is None:
                     raise RemoteSignError("signer not connected")
                 try:
-                    reader, writer = q.get_nowait()
+                    reader, writer = self._conn_q.get_nowait()
                 except asyncio.QueueEmpty:
                     raise RemoteSignError("signer not connected")
                 await self._adopt(reader, writer)

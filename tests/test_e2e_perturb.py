@@ -251,3 +251,48 @@ def test_abci_manifest_validation():
             "nodes": 2, "wait_height": 9, "abci": "tcp",
             "validator_updates": [
                 {"node": 0, "at_height": 2, "power": 5}]})
+
+
+def test_remote_signer_privval_net(tmp_path):
+    """privval = "tcp" (reference PrivvalProtocol dimension): every
+    validator key lives in a signer sidecar process dialing its node
+    over SecretConnection; no node home has a key. A node restart
+    perturbation forces signer redial mid-run; the net keeps
+    committing and nobody forks."""
+    m = Manifest.from_dict({
+        "chain_id": "privval-chain",
+        "nodes": 3,
+        "wait_height": 6,
+        "load_tx_rate": 2.0,
+        "timeout_commit_ms": 150,
+        "privval": "tcp",
+        "perturbations": [
+            {"node": 1, "op": "restart", "at_height": 3},
+        ],
+    })
+    runner = Runner(m, str(tmp_path / "net"), base_port=28100,
+                    log=lambda s: None)
+    report = asyncio.run(asyncio.wait_for(runner.run(), timeout=3000))
+    assert report["ok"] and report["nodes"] == 3
+    net = str(tmp_path / "net")
+    for i in range(3):
+        assert not os.path.exists(os.path.join(
+            net, f"node{i}", "config", "priv_validator_key.json")), \
+            "node home must NOT hold the validator key"
+        slog = open(os.path.join(net, f"signer{i}",
+                                 "signer.log")).read()
+        assert "connected to validator" in slog
+    # the restarted node's signer redialed
+    s1 = open(os.path.join(net, "signer1", "signer.log")).read()
+    assert s1.count("connected to validator") >= 2
+
+
+def test_privval_manifest_validation():
+    import pytest
+
+    with pytest.raises(ValueError):
+        Manifest.from_dict({"nodes": 2, "privval": "unix2"})
+    with pytest.raises(ValueError):
+        Manifest.from_dict({"nodes": 2, "privval": "tcp",
+                            "misbehaviors": [
+                                {"node": 0, "spec": "double-prevote@2"}]})
