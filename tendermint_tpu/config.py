@@ -79,12 +79,18 @@ class P2PConfig:
     allow_duplicate_ip: bool = False
     handshake_timeout_s: int = 20
     dial_timeout_s: int = 3
+    # PEX ensure-peers cadence (reference: PEXReactor
+    # ensurePeersPeriod, 30s). Short-lived test nets lower it so
+    # seed-bootstrap discovery converges within the run.
+    pex_ensure_period_s: float = 30.0
 
     def validate_basic(self) -> None:
         if self.max_num_inbound_peers < 0 or self.max_num_outbound_peers < 0:
             raise ValueError("negative peer limits")
         if self.flush_throttle_ms < 0:
             raise ValueError("negative flush throttle")
+        if self.pex_ensure_period_s <= 0:
+            raise ValueError("pex_ensure_period_s must be positive")
 
 
 @dataclass

@@ -108,6 +108,11 @@ class Manifest:
     # priv_validator_laddr over SecretConnection — perturbations then
     # exercise consensus against out-of-process signing.
     privval: str = "file"
+    # Seed-node bootstrap (reference manifest node "seed" role): node 0
+    # runs in PEX seed mode, and every OTHER node's persistent-peer
+    # mesh is REPLACED by seeds=node0 — the net only forms if address
+    # -book gossip discovers the peers (drives PEX/addrbook e2e).
+    seed_bootstrap: bool = False
     # Hold the LAST node back; once the net has snapshots, start it
     # with state sync configured from a live trust hash and make it
     # catch up (reference manifest state_sync node role).
@@ -160,7 +165,7 @@ class Manifest:
                        "load_tx_rate", "timeout_commit_ms",
                        "perturbations", "misbehaviors",
                        "validator_updates", "late_statesync_node",
-                       "abci", "privval"})
+                       "abci", "privval", "seed_bootstrap"})
     _PERTURB_KEYS = frozenset({"node", "op", "at_height", "duration"})
     _MISBEHAVIOR_KEYS = frozenset({"node", "spec"})
     _VALUPDATE_KEYS = frozenset({"node", "at_height", "power"})
@@ -215,6 +220,7 @@ class Manifest:
             late_statesync_node=bool(d.get("late_statesync_node", False)),
             abci=d.get("abci", "builtin"),
             privval=d.get("privval", "file"),
+            seed_bootstrap=bool(d.get("seed_bootstrap", False)),
         )
         m.validate()
         return m

@@ -213,6 +213,7 @@ class Runner:
         self._valset_changes = 0
         self.apps: list[AppProc] = []
         self.signers: list[SignerProc] = []
+        self.seed: NodeProc | None = None
 
     # -- stages --
 
@@ -227,6 +228,8 @@ class Runner:
             "--starting-port", str(self.base_port),
         ])
         assert rc == 0, "testnet generation failed"
+        seed_str = self._make_seed_home() if self.m.seed_bootstrap \
+            else None
         for i in range(self.m.nodes):
             home = os.path.join(self.out_dir, f"node{i}")
             cfg_path = os.path.join(home, "config", "config.toml")
@@ -242,6 +245,14 @@ class Runner:
             # immediately.
             cfg.base.fast_sync = True
             cfg.consensus.timeout_commit_ms = self.m.timeout_commit_ms
+            if seed_str is not None:
+                # the ONLY configured contact is the seed: the mesh
+                # must form via PEX address-book discovery. Fast
+                # ensure cadence so discovery converges inside a
+                # short run (production default is 30 s).
+                cfg.p2p.persistent_peers = ""
+                cfg.p2p.seeds = seed_str
+                cfg.p2p.pex_ensure_period_s = 2.0
             if self.m.abci != "builtin":
                 app_port = self.base_port + 2000 + i
                 cfg.base.proxy_app = f"127.0.0.1:{app_port}"
@@ -280,7 +291,44 @@ class Runner:
             self.nodes.append(NodeProc(
                 i, home, self.base_port + 1000 + i, misbehavior=mb))
 
+    def _make_seed_home(self) -> str:
+        """Create a dedicated NON-validator seed node (reference e2e
+        node role "seed"): fresh keys, the testnet's genesis, PEX seed
+        mode, no peers of its own. Returns its id@addr for the
+        validators' `seeds` config."""
+        from ..config import Config
+        from ..p2p.key import NodeKey
+        from ..privval import FilePV
+
+        home = os.path.join(self.out_dir, "seed")
+        os.makedirs(os.path.join(home, "config"))
+        os.makedirs(os.path.join(home, "data"))
+        shutil.copy(os.path.join(self.out_dir, "node0", "config",
+                                 "genesis.json"),
+                    os.path.join(home, "config", "genesis.json"))
+        nk = NodeKey.load_or_gen(
+            os.path.join(home, "config", "node_key.json"))
+        FilePV.generate(
+            os.path.join(home, "config", "priv_validator_key.json"),
+            os.path.join(home, "data", "priv_validator_state.json"))
+        p2p_port = self.base_port + 500
+        cfg = Config()
+        cfg.base.home = home
+        cfg.base.moniker = "seed"
+        cfg.base.fast_sync = True
+        cfg.consensus.timeout_commit_ms = self.m.timeout_commit_ms
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port}"
+        cfg.p2p.seed_mode = True
+        cfg.p2p.pex_ensure_period_s = 2.0
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{self.base_port + 1500}"
+        cfg.save(os.path.join(home, "config", "config.toml"))
+        self.seed = NodeProc(-1, home, self.base_port + 1500)
+        return f"{nk.id}@127.0.0.1:{p2p_port}"
+
     def start(self) -> None:
+        if self.seed is not None:  # the discovery rendezvous point
+            self.seed.start()
+            self.log("started seed node")
         for app in self.apps:  # app servers first: nodes dial them
             app.start()
         if self.apps:
@@ -586,8 +634,23 @@ class Runner:
                         b["block"]["evidence"]["evidence"])
         forks = {h_: v for h_, v in hashes.items() if len(v) > 1}
         assert not forks, f"FORK detected: {forks}"
+        # live peer counts (reference e2e net_test): min across nodes,
+        # collected while the net is still up — the seed-bootstrap
+        # scenario asserts discovery produced a real mesh from this.
+        # Best of a few samples per node: a seed hanging up after
+        # serving addresses makes single-sample counts transiently low.
+        best = [-1] * len(self.nodes)
+        for _ in range(3):
+            for k, node in enumerate(self.nodes):
+                try:
+                    ni = await self._rpc(node, "net_info")
+                    best[k] = max(best[k], int(ni["n_peers"]))
+                except Exception:
+                    pass
+            await asyncio.sleep(1.0)
         return {"ok": True, "height": h, "nodes": len(self.nodes),
-                "evidence_committed": evidence}
+                "evidence_committed": evidence,
+                "min_peers": min(best) if best else 0}
 
     def cleanup(self) -> None:
         for node in self.nodes:
@@ -600,6 +663,8 @@ class Runner:
             app.terminate()
         for signer in self.signers:
             signer.terminate()
+        if self.seed is not None:
+            self.seed.terminate()
 
 
 def main(argv=None) -> int:

@@ -338,10 +338,14 @@ class Node(Service):
             book_path = None if self.in_memory else \
                 cfg.base.resolve("config/addrbook.json")
             self.addr_book = AddrBook(book_path)
+            # never book (or redial) ourselves: validators' PEX
+            # selections legitimately contain OUR address
+            self.addr_book.add_our_address(self.node_key.id)
             self.pex_reactor = PEXReactor(
                 self.addr_book,
                 seeds=[s for s in cfg.p2p.seeds.split(",") if s],
-                seed_mode=cfg.p2p.seed_mode)
+                seed_mode=cfg.p2p.seed_mode,
+                ensure_period=cfg.p2p.pex_ensure_period_s)
             self.switch.add_reactor("pex", self.pex_reactor)
         self._built = True
 

@@ -72,7 +72,26 @@ class PEXReactor(Reactor):
                 addr = f"{peer.id}@{peer.socket_addr}"
                 self.book.add_address(addr, src=peer.id)
             self.book.mark_good(peer.id)
-        elif self._needs_more_peers():
+            return
+        # Inbound: book the peer's self-reported LISTEN address
+        # (reference pex_reactor.go AddPeer: srcAddrs from
+        # NodeInfo.NetAddress). Without this, a rendezvous node (seed)
+        # can never learn its dialers' addresses and discovery is
+        # structurally impossible — found by a seed-bootstrap net
+        # where every book stayed empty. The observed socket IP
+        # replaces a wildcard/empty listen host.
+        listen = getattr(getattr(peer, "node_info", None),
+                         "listen_addr", "") or ""
+        listen = listen[len("tcp://"):] if listen.startswith("tcp://") \
+            else listen
+        host, _, port = listen.rpartition(":")
+        if port.isdigit():
+            if host in ("", "0.0.0.0", "::"):
+                host = (peer.socket_addr or "").rsplit(":", 1)[0]
+            if host:
+                self.book.add_address(f"{peer.id}@{host}:{port}",
+                                      src=peer.id)
+        if self._needs_more_peers():
             await self._request_addrs(peer)
 
     async def remove_peer(self, peer, reason) -> None:

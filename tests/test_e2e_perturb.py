@@ -296,3 +296,33 @@ def test_privval_manifest_validation():
         Manifest.from_dict({"nodes": 2, "privval": "tcp",
                             "misbehaviors": [
                                 {"node": 0, "spec": "double-prevote@2"}]})
+
+
+def test_seed_bootstrap_net(tmp_path):
+    """seed_bootstrap (reference e2e "seed" node role): validators'
+    ONLY configured contact is a dedicated non-validator seed node;
+    the consensus mesh can only form if PEX address-book discovery
+    spreads the peer addresses — then the net must commit."""
+    m = Manifest.from_dict({
+        "chain_id": "seed-chain",
+        "nodes": 4,
+        "wait_height": 5,
+        "timeout_commit_ms": 150,
+        "seed_bootstrap": True,
+    })
+    runner = Runner(m, str(tmp_path / "net"), base_port=28300,
+                    log=lambda s: None)
+    report = asyncio.run(asyncio.wait_for(runner.run(), timeout=3000))
+    assert report["ok"] and report["nodes"] == 4
+    # A real mesh formed: every validator holds MULTIPLE live peer
+    # connections it was never configured with — possible only because
+    # the seed booked its dialers' listen addresses and served them
+    # back (the accept-path booking this scenario exists to pin).
+    assert report["min_peers"] >= 2, report
+    net = str(tmp_path / "net")
+    # no validator was given a peer directly
+    for i in range(4):
+        cfg = open(os.path.join(net, f"node{i}", "config",
+                                "config.toml")).read()
+        assert 'persistent_peers = ""' in cfg
+        assert "@127.0.0.1:28800" in cfg  # seeds = seed@base+500
