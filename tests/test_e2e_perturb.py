@@ -326,3 +326,44 @@ def test_seed_bootstrap_net(tmp_path):
                                 "config.toml")).read()
         assert 'persistent_peers = ""' in cfg
         assert "@127.0.0.1:28800" in cfg  # seeds = seed@base+500
+
+
+import pytest
+
+
+@pytest.mark.slow
+def test_combined_matrix_dimensions(tmp_path):
+    """The matrix dimensions compose: external socket ABCI apps +
+    remote-signer sidecars + seed-only bootstrap + a kill and a pause
+    in ONE net. Every process-boundary seam (app socket, signer link,
+    PEX discovery) under perturbation simultaneously."""
+    m = Manifest.from_dict({
+        "chain_id": "combo-chain",
+        "nodes": 4,
+        "wait_height": 6,
+        "load_tx_rate": 2.0,
+        "timeout_commit_ms": 150,
+        "abci": "tcp",
+        "privval": "tcp",
+        "seed_bootstrap": True,
+        "perturbations": [
+            {"node": 1, "op": "kill", "at_height": 3},
+            {"node": 2, "op": "pause", "at_height": 4, "duration": 2.0},
+        ],
+    })
+    runner = Runner(m, str(tmp_path / "net"), base_port=28500,
+                    log=lambda s: None)
+    report = asyncio.run(asyncio.wait_for(runner.run(), timeout=3000))
+    assert report["ok"] and report["nodes"] == 4
+    assert report["min_peers"] >= 1
+    net = str(tmp_path / "net")
+    # all three seams were really out-of-process
+    assert "serving KVStoreApp abci=socket" in open(
+        os.path.join(net, "node0", "app.log")).read()
+    assert "connected to validator" in open(
+        os.path.join(net, "signer0", "signer.log")).read()
+    assert not os.path.exists(os.path.join(
+        net, "node0", "config", "priv_validator_key.json"))
+    # the killed node's signer redialed after the restart
+    assert open(os.path.join(net, "signer1", "signer.log")).read() \
+        .count("connected to validator") >= 2
