@@ -430,14 +430,59 @@ def _run_streaming(timeout_s, batch=None, cpu=False):
     return None, f"rc={p.returncode}: " + " | ".join(tail[-3:])[-500:]
 
 
+def _silicon():
+    """tools.silicon_record, or None if unimportable (never let the
+    record machinery break the bench)."""
+    try:
+        from tools import silicon_record
+        return silicon_record
+    except Exception:  # pragma: no cover
+        return None
+
+
+def _record_if_tpu(step, line):
+    """Persist a measured line into docs/measured_silicon.json when it
+    came from a real accelerator (relay-proof record, VERDICT r4 #1).
+    A provisional stage-1 line's `value` is a linear PROJECTION to
+    10,240 lanes, not a measurement — keep the flag and rename the
+    field so the record never passes a projection off as chip data."""
+    sr = _silicon()
+    if sr is None:
+        return
+    payload = {k: v for k, v in line.items() if k != "error"}
+    if payload.pop("provisional", None):
+        payload["value_projected_ms"] = payload.pop("value", None)
+        payload.pop("vs_baseline", None)
+        payload["provisional"] = True
+    try:
+        sr.record_if_tpu(step, line.get("device", ""), payload)
+    except OSError:  # pragma: no cover
+        pass
+
+
+def _with_last_measured(line):
+    sr = _silicon()
+    if sr is not None:
+        try:
+            lm = sr.summary()
+        except Exception:  # pragma: no cover
+            lm = None
+        if lm:
+            line = dict(line)
+            line["last_measured"] = lm
+    return line
+
+
 def main():
     # t=0 placeholder: guarantees a parseable tail from the first
-    # millisecond. Every subsequent line supersedes it.
-    _emit({
+    # millisecond. Every subsequent line supersedes it. Carries the
+    # latest recorded silicon numbers already, so even a kill during
+    # backend init leaves dated chip data in the tail.
+    _emit(_with_last_measured({
         "metric": METRIC, "value": None, "unit": "ms", "vs_baseline": None,
         "provisional": True,
         "note": "placeholder printed at start; a later line supersedes this",
-    })
+    }))
     errors = []
 
     # Gate: is the default backend alive? (~20-40 s cold init when
@@ -472,7 +517,12 @@ def main():
             if err:
                 errors.append(f"tpu retry: {err}")
     if best is not None and not best.get("provisional"):
-        return  # full result already printed by the stream
+        # Full result already printed by the stream; persist it into
+        # the silicon record and re-emit with the record attached so
+        # the tail carries both the fresh number and the history.
+        _record_if_tpu("headline_bench", best)
+        _emit(_with_last_measured(best))
+        return
 
     if best is None and _remaining() > 90:
         # Accelerator never produced a number: flagged CPU-mesh
@@ -486,22 +536,24 @@ def main():
             line["cpu_fallback"] = True
             line["error"] = ("no TPU measurement: " +
                              "; ".join(errors)[:1200])
-            _emit(line)
+            _emit(_with_last_measured(line))
             return
         errors.append(f"cpu fallback: {err}")
 
     if best is not None:
-        # A provisional (1,024-lane) line is the best we got; re-print
-        # it as the tail with the failure history attached.
+        # A provisional (1,024-lane) line is the best we got; persist
+        # it if it came from the chip, then re-print it as the tail
+        # with the failure history attached.
+        _record_if_tpu("bench_stage1_1024", best)
         best["error"] = "; ".join(errors)[:1200] or None
-        _emit(best)
+        _emit(_with_last_measured(best))
         return
 
-    _emit({
+    _emit(_with_last_measured({
         "metric": METRIC, "value": None, "unit": "ms", "vs_baseline": None,
         "error": "; ".join(errors)[:2000],
         "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
-    })
+    }))
 
 
 if __name__ == "__main__":

@@ -93,10 +93,20 @@ def main():
 
     import jax
 
-    print(f"device: {jax.devices()[0]}")
+    device = str(jax.devices()[0])
+    print(f"device: {device}")
     width = max(len(r[0]) for r in rows)
     for name, secs in rows:
         print(f"{name:<{width}}  {secs * 1e6:>12.1f} us")
+
+    if "--record" in sys.argv:
+        from tools import silicon_record
+
+        payload = {"device": device, "batch": batch}
+        payload.update(
+            {name: round(secs * 1e6, 2) for name, secs in rows})
+        print("recorded ->", silicon_record.record_if_tpu(
+            "crypto_bench_us", device, payload))
 
 
 if __name__ == "__main__":

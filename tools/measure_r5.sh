@@ -1,0 +1,81 @@
+#!/bin/bash
+# Round-5 silicon measurement watcher. Marker-guarded like
+# measure_r4b.sh: probe the relay cheaply every 180 s; when the chip
+# answers, run the measurement sequence. Every step both persists its
+# XLA compiles into the shared compilation cache (so the driver's
+# end-of-round bench compiles nothing) AND records its numbers into
+# docs/measured_silicon.json (tools/silicon_record.py) so the
+# driver-visible bench tail carries dated chip data even if the relay
+# is wedged at end of round (VERDICT r4 next-round ask #1).
+#
+# Step order: profile first (smaller compiles land cache entries
+# incrementally; gives the unmeasured wpi=3 @10,240 device-exec split
+# — ask #2), then the headline bench (warms the EXACT end-of-round
+# shapes incl. the structured-commit stage = the structured-vs-bytes
+# A/B on silicon), then threshold sweep and crypto micro-bench.
+set -u
+OUT=${OUT:-/tmp/r5}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+export JAX_COMPILATION_CACHE_DIR=/tmp/tm_tpu_jax_cache
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
+
+log() { echo "[$(date -u +%H:%M:%S)] $*" >> "$OUT/measure.log"; }
+
+probe() {
+    timeout 150 python - <<'EOF' >/dev/null 2>&1
+import jax
+assert any("tpu" in str(d).lower() for d in jax.devices())
+EOF
+}
+
+bench_ok() {
+    python - "$OUT/bench.out" <<'EOF' >/dev/null 2>&1
+import json, sys
+last = None
+for ln in open(sys.argv[1], errors="replace"):
+    ln = ln.strip()
+    if ln.startswith("{") and ln.endswith("}"):
+        try:
+            last = json.loads(ln)
+        except ValueError:
+            pass
+assert last and isinstance(last.get("value"), (int, float))
+assert not last.get("provisional") and not last.get("cpu_fallback")
+EOF
+}
+
+step() {  # step NAME TIMEOUT CMD... — run once, marker-guarded
+    local name=$1 tmo=$2; shift 2
+    [ -e "$OUT/done.$name" ] && return 0
+    timeout "$tmo" "$@" > "$OUT/$name.out" 2>&1
+    local rc=$?
+    log "$name rc=$rc"
+    [ $rc -eq 0 ] && touch "$OUT/done.$name"
+    return $rc
+}
+
+log "watcher r5 started"
+while true; do
+    if ! probe; then
+        sleep 180
+        continue
+    fi
+    log "probe OK - chip is up"
+    step prof_10240_wpi3 1500 python tools/profile_tpu.py 10240 10240 \
+        --record || { sleep 60; continue; }
+    if [ ! -e "$OUT/done.bench" ]; then
+        TM_TPU_BENCH_DEADLINE_S=900 timeout 950 python bench.py \
+            > "$OUT/bench.out" 2>&1
+        log "bench rc=$?"
+        bench_ok && touch "$OUT/done.bench" || { sleep 60; continue; }
+        log "clean headline bench landed (incl structured A/B)"
+    fi
+    step sweep 1500 python tools/sweep_thresholds.py \
+        --sizes 16,32,64,128,256,512,1024,2048 --sr-sizes 16,64,256 \
+        --out docs/THRESHOLDS_r5.md --record || { sleep 60; continue; }
+    step crypto_bench 900 python tools/crypto_bench.py --record \
+        || { sleep 60; continue; }
+    log "sequence complete - COMMIT docs/measured_silicon.json - exiting"
+    exit 0
+done

@@ -19,8 +19,10 @@ def log(msg):
 
 
 def main():
-    n_keys = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
-    n_lanes = int(sys.argv[2]) if len(sys.argv) > 2 else n_keys
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    do_record = "--record" in sys.argv
+    n_keys = int(args[0]) if args else 1024
+    n_lanes = int(args[1]) if len(args) > 1 else n_keys
 
     log("importing jax...")
     import jax
@@ -60,19 +62,27 @@ def main():
         f"shape {exp.tables.shape} "
         f"({exp.tables.size * 4 / 2**30:.2f} GiB)")
 
+    rec = {"n_keys": n_keys, "n_lanes": n_lanes,
+           "device": str(jax.devices()[0]),
+           "windows_per_iter": ex.WINDOWS_PER_ITER}
+
     t = time.perf_counter()
     out = exp.verify(idx, msgs, sigs)
     log(f"first verify (compile+run) {time.perf_counter() - t:.2f}s; "
         f"all={bool(out.all())}")
 
+    warms = []
     for i in range(3):
         t = time.perf_counter()
         out = exp.verify(idx, msgs, sigs)
-        log(f"warm verify #{i} {1e3 * (time.perf_counter() - t):.1f}ms")
+        warms.append(time.perf_counter() - t)
+        log(f"warm verify #{i} {1e3 * warms[-1]:.1f}ms")
+    rec["warm_verify_p50_ms"] = round(1e3 * sorted(warms)[1], 2)
 
     t = time.perf_counter()
     pidx, packed, _ = exp._prepare(idx, msgs, sigs)
-    log(f"host prepare {1e3 * (time.perf_counter() - t):.1f}ms")
+    rec["host_prepare_ms"] = round(1e3 * (time.perf_counter() - t), 2)
+    log(f"host prepare {rec['host_prepare_ms']:.1f}ms")
     for i in range(3):
         t = time.perf_counter()
         o = exp._launch(pidx, packed)
@@ -93,6 +103,9 @@ def main():
             f"{1e3 * tt:.1f}ms")
     log(f"single synced launch {1e3 * single:.1f}ms; device exec "
         f"{'unmeasurable (relay jitter)' if per is None else f'{1e3 * per:.2f}ms'}/launch")
+    rec["single_launch_synced_ms"] = round(1e3 * single, 2)
+    rec["device_exec_ms_per_launch"] = (
+        round(1e3 * per, 3) if per else None)
     # Same launches from host numpy inputs: includes per-call
     # host->device transfer (the production cold-call shape).
     for k in (1, 4):
@@ -102,6 +115,15 @@ def main():
         dt = 1e3 * (time.perf_counter() - t)
         log(f"pipelined x{k} (host inputs): total {dt:.1f}ms "
             f"({dt / k:.1f}ms/launch)")
+        rec[f"host_input_pipelined_x{k}_ms_per_launch"] = round(dt / k, 2)
+
+    if do_record:
+        from tools import silicon_record
+
+        path = silicon_record.record_if_tpu(
+            f"profile_{n_lanes}_wpi{rec['windows_per_iter']}",
+            rec["device"], rec)
+        log(f"recorded -> {path}")
 
 
 if __name__ == "__main__":

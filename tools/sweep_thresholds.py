@@ -111,6 +111,7 @@ def main():
         s for s in SIZES if s <= 1024)
     idx_ab = list(range(n_ab))
     ab_res = {}
+    wpi_default = ex.WINDOWS_PER_ITER
     for wpi in (1, 3, 23):
         ex.WINDOWS_PER_ITER = wpi
         try:
@@ -121,7 +122,7 @@ def main():
             print(f"expanded wpi={wpi} @ {n_ab}: {t * 1e3:.2f} ms",
                   flush=True)
         finally:
-            ex.WINDOWS_PER_ITER = 1
+            ex.WINDOWS_PER_ITER = wpi_default
     results["ed25519"]["windows_per_iter_ms"] = ab_res
 
     # sr25519
@@ -201,6 +202,29 @@ def main():
         f.write("\nRaw JSON:\n\n```json\n"
                 + json.dumps(results, indent=1) + "\n```\n")
     print(f"wrote {out_path}")
+
+    if "--record" in sys.argv:
+        from tools import silicon_record
+
+        flat = {"device": device}
+        for n in SIZES:
+            r = results["ed25519"][n]
+            flat[f"ed25519_n{n}_general_ms"] = r["general_ms"]
+            flat[f"ed25519_n{n}_expanded_ms"] = r["expanded_ms"]
+            flat[f"ed25519_n{n}_host_ms"] = r["host_ms"]
+        for wpi, ms in results["ed25519"].get(
+                "windows_per_iter_ms", {}).items():
+            flat[f"wpi{wpi}_ms"] = ms
+        for n in SR_SIZES:
+            r = results["sr25519"][n]
+            flat[f"sr25519_n{n}_device_ms"] = r["device_ms"]
+            flat[f"sr25519_n{n}_host_ms"] = r["host_ms"]
+        flat["sr25519_host_ms_per_sig"] = \
+            results["sr25519"]["host_ms_per_sig"]
+        for k, v in results["recommend"].items():
+            flat[f"recommend{k if k.startswith('_') else '_' + k}"] = v
+        print("recorded ->", silicon_record.record_if_tpu(
+            "threshold_sweep", device, flat))
 
 
 if __name__ == "__main__":
