@@ -157,9 +157,12 @@ class NodeProc:
         self.log_path = os.path.join(home, "node.log")
         self._log_f = None
 
-    def start(self) -> None:
+    def start(self, extra_env: dict | None = None) -> None:
+        """extra_env applies to THIS boot only (the failpoint sweep
+        injects FAIL_TEST_INDEX for the crashing boot, restarts clean)."""
         assert self.proc is None or self.proc.poll() is not None
         env = _child_env()
+        env.update(extra_env or {})
         cmd = [sys.executable, "-m", "tendermint_tpu.cmd",
                "--home", self.home, "start"]
         if os.environ.get("TM_E2E_DEBUG"):

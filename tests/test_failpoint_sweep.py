@@ -1,0 +1,121 @@
+"""Failpoint crash-recovery sweep (VERDICT r4 ask #3).
+
+Reference: libs/fail/fail.go:9-40 (FAIL_TEST_INDEX selects which
+fail.Fail() call-site os.Exit(1)s the process) exercised by
+consensus/replay_test.go's crash-simulation tests. Here each
+parameterized case runs a REAL solo-validator node subprocess with
+FAIL_TEST_INDEX=k, which kills it hard (os._exit, no cleanup) at one
+of the six persistence-boundary crash points:
+
+    k%6  site
+    0    consensus/state.py  block saved, WAL end-height not written
+    1    consensus/state.py  WAL delimited, state not yet applied
+    2    state/execution.py  block executed, responses not saved
+    3    state/execution.py  responses saved, state not updated
+    4    state/execution.py  app committed, state not saved
+    5    state/execution.py  everything saved, events not fired
+
+k//6 is the height at which the crash fires (every committed height
+passes all six sites in order). The node is then restarted WITHOUT the
+env var and must recover via WAL replay + ABCI handshake to a
+consistent state and keep committing blocks — proving the
+WAL/ApplyBlock atomicity story at exactly these boundaries instead of
+asserting it.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from tendermint_tpu.cmd import main as cli_main
+from tendermint_tpu.config import Config
+from tendermint_tpu.e2e.runner import NodeProc, wait_progress
+
+BASE_PORT = 28700
+N_SITES = 6
+
+
+def _make_home(tmp_path, port_off: int) -> tuple[str, int]:
+    out = str(tmp_path / "net")
+    rc = cli_main(["testnet", "--v", "1", "--o", out,
+                   "--chain-id", "failpoint-chain",
+                   "--starting-port", str(BASE_PORT + port_off)])
+    assert rc == 0
+    home = os.path.join(out, "node0")
+    cfg_path = os.path.join(home, "config", "config.toml")
+    cfg = Config.load(cfg_path)
+    cfg.base.home = home
+    cfg.consensus.timeout_commit_ms = 100
+    cfg.save(cfg_path)
+    return home, BASE_PORT + port_off + 1000
+
+
+async def _height(node: NodeProc) -> int:
+    from tendermint_tpu.rpc.jsonrpc import HTTPClient
+
+    st = await HTTPClient("127.0.0.1", node.rpc_port,
+                          timeout=5).call("status")
+    return int(st["sync_info"]["latest_block_height"])
+
+
+def _run_site(tmp_path, fail_index: int, port_off: int) -> None:
+    crash_height = fail_index // N_SITES + 1
+    home, rpc_port = _make_home(tmp_path, port_off)
+    node = NodeProc(0, home, rpc_port)
+    node.start(extra_env={"FAIL_TEST_INDEX": str(fail_index)})
+    try:
+        # The crash point fires during the commit of `crash_height`;
+        # the process must die hard with rc=1 (os._exit in fail()).
+        rc = node.proc.wait(timeout=120)
+        assert rc == 1, (
+            f"node should have crashed at fail site {fail_index} "
+            f"(rc={rc}); log tail:\n"
+            + open(node.log_path, "rb").read()[-2000:].decode(
+                "utf-8", "replace"))
+
+        # Restart clean: WAL replay + handshake must reconcile
+        # whatever subset of {block store, WAL end-height, ABCI
+        # responses, app commit, state store} the crash left behind,
+        # then consensus continues PAST the crash height.
+        node.start()
+
+        async def recovered():
+            async def sample():
+                try:
+                    return await _height(node)
+                except Exception:
+                    return -1
+
+            await wait_progress(
+                sample, lambda h: h >= crash_height + 2,
+                timeout=60, stall_timeout=45,
+                what=f"post-recovery height {crash_height + 2} "
+                     f"(site {fail_index})")
+
+        asyncio.run(recovered())
+        log = open(node.log_path, "rb").read()
+        assert log.count(b"node node0 started") == 2
+    finally:
+        node.terminate()
+
+
+# One representative site in the default suite: the WAL-delimited /
+# state-not-applied boundary (k=1) — the replay path where the WAL
+# says the height ended but ApplyBlock never ran.
+def test_failpoint_wal_delimited_state_not_applied(tmp_path):
+    _run_site(tmp_path, 1, 0)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fail_index", [0, 2, 3, 4, 5])
+def test_failpoint_sweep_height1(tmp_path, fail_index):
+    _run_site(tmp_path, fail_index, 10 * (1 + fail_index))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fail_index", [6, 7, 8, 9, 10, 11])
+def test_failpoint_sweep_height2(tmp_path, fail_index):
+    """Crash during the SECOND height's commit: recovery now also
+    replays a previously-committed block behind the crashed one."""
+    _run_site(tmp_path, fail_index, 100 + 10 * (fail_index - 6))
