@@ -28,7 +28,8 @@ MAX_CLOCK_DRIFT_NS = 10 * 1_000_000_000  # reference defaultMaxClockDrift
 
 def _common_checks(chain_id: str, trusted: LightBlock,
                    untrusted: LightBlock, trusting_period_ns: int,
-                   now_ns: int) -> None:
+                   now_ns: int,
+                   max_clock_drift_ns: int = MAX_CLOCK_DRIFT_NS) -> None:
     untrusted.validate_basic(chain_id)
     if untrusted.height() <= trusted.height():
         raise VerificationFailedError(
@@ -42,18 +43,19 @@ def _common_checks(chain_id: str, trusted: LightBlock,
     if untrusted.time() <= trusted.time():
         raise VerificationFailedError(
             "untrusted header time not after trusted header time")
-    if untrusted.time() >= now_ns + MAX_CLOCK_DRIFT_NS:
+    if untrusted.time() >= now_ns + max_clock_drift_ns:
         raise VerificationFailedError(
             "untrusted header is from the future (clock drift exceeded)")
 
 
 def verify_adjacent(chain_id: str, trusted: LightBlock,
                     untrusted: LightBlock, trusting_period_ns: int,
-                    now_ns: int) -> None:
+                    now_ns: int,
+                    max_clock_drift_ns: int = MAX_CLOCK_DRIFT_NS) -> None:
     if untrusted.height() != trusted.height() + 1:
         raise VerificationFailedError("headers must be adjacent")
     _common_checks(chain_id, trusted, untrusted, trusting_period_ns,
-                   now_ns)
+                   now_ns, max_clock_drift_ns)
     if untrusted.signed_header.header.validators_hash != \
             trusted.signed_header.header.next_validators_hash:
         raise VerificationFailedError(
@@ -69,12 +71,14 @@ def verify_adjacent(chain_id: str, trusted: LightBlock,
 def verify_non_adjacent(chain_id: str, trusted: LightBlock,
                         untrusted: LightBlock, trusting_period_ns: int,
                         now_ns: int,
-                        trust_level: Fraction = DEFAULT_TRUST_LEVEL) -> None:
+                        trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+                        max_clock_drift_ns: int = MAX_CLOCK_DRIFT_NS) -> None:
     if untrusted.height() == trusted.height() + 1:
         return verify_adjacent(chain_id, trusted, untrusted,
-                               trusting_period_ns, now_ns)
+                               trusting_period_ns, now_ns,
+                               max_clock_drift_ns)
     _common_checks(chain_id, trusted, untrusted, trusting_period_ns,
-                   now_ns)
+                   now_ns, max_clock_drift_ns)
     sh = untrusted.signed_header
     # ≥ trust-level of the TRUSTED valset must have signed the new block
     try:
@@ -114,11 +118,13 @@ def verify_backwards(untrusted_header, trusted_header) -> None:
 
 def verify(chain_id: str, trusted: LightBlock, untrusted: LightBlock,
            trusting_period_ns: int, now_ns: int,
-           trust_level: Fraction = DEFAULT_TRUST_LEVEL) -> None:
+           trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+           max_clock_drift_ns: int = MAX_CLOCK_DRIFT_NS) -> None:
     """reference: light/verifier.go:150 Verify — dispatch on adjacency."""
     if untrusted.height() == trusted.height() + 1:
         verify_adjacent(chain_id, trusted, untrusted, trusting_period_ns,
-                        now_ns)
+                        now_ns, max_clock_drift_ns)
     else:
         verify_non_adjacent(chain_id, trusted, untrusted,
-                            trusting_period_ns, now_ns, trust_level)
+                            trusting_period_ns, now_ns, trust_level,
+                            max_clock_drift_ns)

@@ -85,16 +85,29 @@ NIL_BLOCK_ID = BlockID(b"", None)
 
 
 def block_id_writer(bid: BlockID | None) -> Writer | None:
+    """tmproto.BlockID. part_set_header is gogoproto nullable=false in
+    the reference (types.proto:98-99), so whenever a BlockID message is
+    marshaled at all, field 2 is present — even as an empty submessage.
+    Cross-validated against the reference MBT corpus header hashes
+    (light/mbt_ref.py)."""
     if bid is None or (bid.is_nil() and bid.part_set_header is None):
         return None
     w = Writer()
     w.bytes(1, bid.hash)
-    if bid.part_set_header is not None and not bid.part_set_header.is_zero():
-        pw = Writer()
-        pw.varint(1, bid.part_set_header.total)
-        pw.bytes(2, bid.part_set_header.hash)
-        w.message(2, pw)
+    pw = Writer()
+    psh = bid.part_set_header
+    if psh is not None:
+        pw.varint(1, psh.total)
+        pw.bytes(2, psh.hash)
+    w.message(2, pw)
     return w
+
+
+def zero_block_id_bytes() -> bytes:
+    """Marshal of a ZERO tmproto.BlockID — not empty: the non-nullable
+    part_set_header still emits (reference gogo semantics; the
+    Header.hash leaf for a genesis last_block_id depends on this)."""
+    return Writer().message(2, Writer()).finish()
 
 
 def read_block_id(data: bytes) -> BlockID:
@@ -323,12 +336,13 @@ class Header:
                 # (field 1, length-delimited) before hashing
                 return Writer().bytes(1, b).finish()
 
+            lbid = block_id_writer(self.last_block_id)
             fields = [
                 vw.finish(),
                 Writer().string(1, self.chain_id).finish(),
                 Writer().varint(1, self.height).finish(),
                 (canonical.timestamp_writer(self.time) or Writer()).finish(),
-                (block_id_writer(self.last_block_id) or Writer()).finish(),
+                lbid.finish() if lbid is not None else zero_block_id_bytes(),
                 bv(self.last_commit_hash),
                 bv(self.data_hash),
                 bv(self.validators_hash),

@@ -32,17 +32,21 @@ def timestamp_writer(time_ns: int) -> Writer | None:
 
 
 def canonical_block_id_writer(block_id) -> Writer | None:
-    """block_id: types.block.BlockID or None."""
+    """block_id: types.block.BlockID or None. CanonicalizeBlockID
+    returns nil for a zero block id (field omitted — nil votes), but a
+    present CanonicalBlockID always carries its part_set_header: the
+    field is gogoproto nullable=false (canonical.proto:12), so the
+    reference emits it even when empty."""
     if block_id is None or block_id.is_nil():
         return None
     w = Writer()
     w.bytes(1, block_id.hash)
+    pw = Writer()
     psh = block_id.part_set_header
-    if psh is not None and not psh.is_zero():
-        pw = Writer()
+    if psh is not None:
         pw.varint(1, psh.total)
         pw.bytes(2, psh.hash)
-        w.message(2, pw)
+    w.message(2, pw)
     return w
 
 

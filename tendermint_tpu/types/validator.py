@@ -4,8 +4,32 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from ..crypto import PubKey
+from ..crypto import PubKey, pubkey_from_type_and_bytes
 from ..encoding.proto import Reader, Writer
+
+# crypto.PublicKey oneof field numbers (reference:
+# proto/tendermint/crypto/keys.proto — ed25519=1, secp256k1=2).
+# sr25519=3 is a repo extension: the reference's codec.go rejects
+# sr25519 keys in proto entirely; field 3 follows the upstream
+# tendermint v0.35 assignment so a future reference can interop.
+_PK_ONEOF = {"ed25519": 1, "secp256k1": 2, "sr25519": 3}
+_PK_ONEOF_REV = {v: k for k, v in _PK_ONEOF.items()}
+
+
+def pubkey_proto_writer(pk: PubKey) -> Writer:
+    w = Writer()
+    w.bytes(_PK_ONEOF[pk.type_name], pk.bytes(), skip_empty=False)
+    return w
+
+
+def pubkey_from_proto_bytes(data: bytes) -> PubKey:
+    r = Reader(data)
+    while not r.at_end():
+        f, wt = r.field()
+        if f in _PK_ONEOF_REV:
+            return pubkey_from_type_and_bytes(_PK_ONEOF_REV[f], r.bytes())
+        r.skip(wt)
+    raise ValueError("PublicKey proto has no known oneof field")
 
 
 @dataclass
@@ -42,12 +66,13 @@ class Validator:
 
     def bytes_for_hash(self) -> bytes:
         """Deterministic encoding hashed into ValidatorsHash
-        (reference: types/validator.go Validator.Bytes)."""
+        (reference: types/validator.go Validator.Bytes =
+        SimpleValidator{PublicKey pub_key = 1, int64 voting_power = 2}
+        with the crypto.PublicKey oneof of keys.proto). Cross-validated
+        against the reference's TLA+ MBT corpus, which carries real
+        validators_hash values (light/mbt_ref.py)."""
         w = Writer()
-        pkw = Writer()
-        pkw.string(1, self.pub_key.type_name)
-        pkw.bytes(2, self.pub_key.bytes())
-        w.message(1, pkw)
+        w.message(1, pubkey_proto_writer(self.pub_key))
         w.varint(2, self.voting_power)
         return w.finish()
 
@@ -57,12 +82,12 @@ class Validator:
         )
 
     def to_proto(self) -> Writer:
+        """reference: proto/tendermint/types/validator.proto Validator
+        {address=1, PublicKey pub_key=2, voting_power=3,
+        proposer_priority=4}."""
         w = Writer()
         w.bytes(1, self.address)
-        pkw = Writer()
-        pkw.string(1, self.pub_key.type_name)
-        pkw.bytes(2, self.pub_key.bytes())
-        w.message(2, pkw)
+        w.message(2, pubkey_proto_writer(self.pub_key))
         w.varint(3, self.voting_power)
         # two's-complement for possibly-negative priority
         w.varint(4, self.proposer_priority)
@@ -70,8 +95,6 @@ class Validator:
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Validator":
-        from .. import crypto
-
         r = Reader(data)
         addr = b""
         pk = None
@@ -82,17 +105,7 @@ class Validator:
             if f == 1:
                 addr = r.bytes()
             elif f == 2:
-                rr = Reader(r.bytes())
-                tname, kb = "", b""
-                while not rr.at_end():
-                    ff, wwt = rr.field()
-                    if ff == 1:
-                        tname = rr.string()
-                    elif ff == 2:
-                        kb = rr.bytes()
-                    else:
-                        rr.skip(wwt)
-                pk = crypto.pubkey_from_type_and_bytes(tname, kb)
+                pk = pubkey_from_proto_bytes(r.bytes())
             elif f == 3:
                 power = r.varint()
             elif f == 4:
