@@ -10,9 +10,10 @@ TOML schema:
 
     [[perturbations]]
     node = 1                     # node index
-    op = "kill"                  # kill | pause | disconnect | restart
+    op = "kill"                  # kill | pause | disconnect |
+                                 #   disconnect_hard | restart
     at_height = 3                # trigger when the net reaches this
-    duration = 3.0               # pause/disconnect length (seconds)
+    duration = 3.0               # pause/disconnect/sever length (s)
 
     [[validator_updates]]        # scheduled valset change
     node = 3                     # whose power to change
@@ -24,7 +25,10 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-OPS = ("kill", "pause", "disconnect", "restart")
+# disconnect = long SIGSTOP (peers observe a stall); disconnect_hard =
+# TCP severance via the switch's sever() hook (peers observe connection
+# RESETS and must re-dial — reference perturb.go severs the docker net)
+OPS = ("kill", "pause", "disconnect", "disconnect_hard", "restart")
 
 
 @dataclass
@@ -41,6 +45,10 @@ class Perturbation:
             raise ValueError(f"perturbation node {self.node} out of range")
         if self.at_height < 1:
             raise ValueError("perturbation at_height must be >= 1")
+        if self.op == "disconnect_hard" and not 0 < self.duration <= 60:
+            # same bound the unsafe_net_sever RPC enforces — reject at
+            # manifest load, not mid-run
+            raise ValueError("disconnect_hard duration must be in (0, 60]")
 
 
 @dataclass

@@ -248,14 +248,20 @@ class Runner:
             # immediately.
             cfg.base.fast_sync = True
             cfg.consensus.timeout_commit_ms = self.m.timeout_commit_ms
+            # Test-speed PEX cadence for EVERY e2e node (the request
+            # rate limits scale with it, p2p/pex/reactor.py): a
+            # severed/killed node must rediscover peers within a test
+            # run, not on the 30 s production cadence.
+            cfg.p2p.pex_ensure_period_s = 2.0
+            if any(p.op == "disconnect_hard"
+                   for p in self.m.perturbations):
+                cfg.rpc.unsafe = True  # exposes unsafe_net_sever
             if seed_str is not None:
                 # the ONLY configured contact is the seed: the mesh
-                # must form via PEX address-book discovery. Fast
-                # ensure cadence so discovery converges inside a
-                # short run (production default is 30 s).
+                # must form via PEX address-book discovery (fast
+                # cadence set above for every node)
                 cfg.p2p.persistent_peers = ""
                 cfg.p2p.seeds = seed_str
-                cfg.p2p.pex_ensure_period_s = 2.0
             if self.m.abci != "builtin":
                 app_port = self.base_port + 2000 + i
                 cfg.base.proxy_app = f"127.0.0.1:{app_port}"
@@ -494,6 +500,15 @@ class Runner:
             node.sigstop()
             await asyncio.sleep(p.duration)
             node.sigcont()
+        elif p.op == "disconnect_hard":
+            # real TCP severance via the node's unsafe RPC hook: its
+            # switch closes every conn (peers see resets) and refuses
+            # redials for the window
+            res = await self._rpc(node, "unsafe_net_sever",
+                                  seconds=p.duration)
+            self.log(f"perturb: node{p.node} dropped "
+                     f"{res['connections_dropped']} conns")
+            await asyncio.sleep(p.duration)
         else:  # pragma: no cover - manifest validated
             raise ValueError(p.op)
 

@@ -76,6 +76,7 @@ class Switch(Service):
         self.max_inbound = max_inbound
         self.max_outbound = max_outbound
         self._reconnect_tasks: dict[str, asyncio.Task] = {}
+        self._sever_until = 0.0                  # sever() test hook
         self.addr_book = None                    # set by PEX wiring
         self.reporter = None                     # behaviour.SwitchReporter
 
@@ -114,6 +115,11 @@ class Switch(Service):
     async def _accept_routine(self) -> None:
         while True:
             conn, ni, sock_addr = await self.transport.accept()
+            if self.severed():
+                self.logger.info("severed: refusing inbound %s",
+                                 ni.node_id[:12])
+                conn.close()
+                continue
             try:
                 await self._add_peer(conn, ni, outbound=False,
                                      socket_addr=sock_addr)
@@ -172,9 +178,33 @@ class Switch(Service):
 
     # -- outbound --
 
+    # -- network severance (test hook; reference analogue:
+    # test/e2e/runner/perturb.go:12-60 severs the docker network) --
+
+    def severed(self) -> bool:
+        return asyncio.get_running_loop().time() < self._sever_until
+
+    async def sever(self, duration_s: float) -> int:
+        """Hard TCP disconnect: close every peer connection both ways
+        (remotes observe a connection RESET, not a stall) and refuse
+        dials/accepts for `duration_s`. Reconnect then runs through
+        the real persistent-peer backoff and PEX re-discovery paths.
+        Returns the number of connections dropped."""
+        self._sever_until = asyncio.get_running_loop().time() + duration_s
+        dropped = 0
+        for peer in list(self.peers.values()):
+            await self.stop_peer_for_error(
+                peer, "network severed (test hook)")
+            dropped += 1
+        self.logger.info("severed network for %.1fs (%d conns dropped)",
+                         duration_s, dropped)
+        return dropped
+
     async def dial_peer(self, addr: str, persistent: bool = False) -> Peer | None:
         """addr = 'host:port' or 'id@host:port'."""
         expect_id, hostport = _split_addr(addr)
+        if self.severed():
+            raise SwitchError("network severed (test hook)")
         if addr in self.dialing:
             return None
         self.dialing.add(addr)

@@ -194,6 +194,7 @@ class Environment:
             # exposed only with rpc.unsafe = true)
             **({
                 "unsafe_flush_mempool": self.unsafe_flush_mempool,
+                "unsafe_net_sever": self.unsafe_net_sever,
                 "dial_seeds": self.dial_seeds,
                 "dial_peers": self.dial_peers,
             } if getattr(self.node.config.rpc, "unsafe", False) else {}),
@@ -504,6 +505,18 @@ class Environment:
         """reference: rpc/core/mempool.go UnsafeFlushMempool."""
         await self.node.mempool.flush()
         return {}
+
+    async def unsafe_net_sever(self, ctx, seconds="3") -> dict:
+        """Test hook (no reference route — the reference e2e runner
+        severs the docker network instead, perturb.go:12-60): hard-drop
+        every p2p connection and refuse dials/accepts for `seconds`,
+        so peers observe connection loss (not a stall) and the
+        reconnect/backoff/PEX paths run for real."""
+        secs = float(seconds)
+        if not 0 < secs <= 60:
+            raise RPCError(-32602, "seconds must be in (0, 60]")
+        dropped = await self.node.switch.sever(secs)
+        return {"severed_for": secs, "connections_dropped": dropped}
 
     async def dial_seeds(self, ctx, seeds=()) -> dict:
         """reference: rpc/core/net.go UnsafeDialSeeds."""

