@@ -38,6 +38,10 @@ _DEVICE_THRESHOLD = 40
 # (pure Python + SIMD Merlin), ~37x ed25519's — so its device
 # crossover is a handful of lanes, not 40.
 _DEVICE_THRESHOLD_SR = 4
+# Degraded mode (accelerator down): batches at least this big route to
+# the XLA-CPU-jitted sr25519 kernel instead of the ~5.5 ms/sig pure-
+# Python oracle; smaller ones aren't worth a (cached) CPU compile.
+_CPU_JIT_THRESHOLD_SR = 16
 
 # Device-failure degradation: a kernel launch raising (wedged relay,
 # OOM, backend death) marks the device down for a cooldown; every
@@ -163,6 +167,31 @@ class BatchVerifier:
                         "device sr25519 batch failed (%d lanes); "
                         "degrading to host for %.0fs",
                         len(items), DEVICE_RETRY_COOLDOWN_S)
+            # Degraded-mode fast path: the same kernel pinned to the
+            # XLA CPU backend. The pure-Python oracle costs ~5.5
+            # ms/sig — a device outage on an sr25519-heavy chain would
+            # take ~55 s per 10k commit; the CPU-jitted kernel keeps
+            # degraded commits at sane cadence (VERDICT r4 ask #7).
+            # (use_dev: only when the caller WANTED the device — an
+            # explicit use_device=False keeps the per-sig oracle.)
+            if use_dev and len(items) >= _CPU_JIT_THRESHOLD_SR:
+                try:
+                    from .tpu import sr_verify
+
+                    out = sr_verify.verify_batch_sr(
+                        [pk.bytes() for pk, _, _ in items],
+                        [m for _, m, _ in items],
+                        [s for _, _, s in items],
+                        cpu=True,
+                    )
+                    met.batch_lanes.inc(len(items),
+                                        backend="cpu-jit-sr25519")
+                    return out
+                except Exception:
+                    logger.exception(
+                        "CPU-jit sr25519 batch failed (%d lanes); "
+                        "falling back to per-sig host oracle",
+                        len(items))
         met.batch_lanes.inc(len(items), backend=f"host-{type_name}")
         # Remaining key types (secp256k1; small sr25519 groups):
         # host-side one-by-one via the PubKey objects we already hold.

@@ -106,12 +106,20 @@ def _nibbles(ints, n: int) -> np.ndarray:
     return out
 
 
-def verify_batch_sr(pubs, msgs, sigs, ctx: bytes = b"") -> np.ndarray:
+def verify_batch_sr(pubs, msgs, sigs, ctx: bytes = b"",
+                    *, cpu: bool = False) -> np.ndarray:
     """Batched schnorrkel verify on the default JAX device.
 
     Returns per-lane verdicts (N,) bool; semantics identical to
     sr25519_ref.verify (marker bit required, canonical s < L,
     ristretto-canonical A and R encodings).
+
+    cpu=True pins the SAME kernel to the XLA CPU backend (native host
+    code, no accelerator traffic): the device-outage degradation path
+    for sr25519-heavy chains, where the pure-Python oracle's ~5.5
+    ms/sig would stall a 10k commit for a minute (VERDICT r4 ask #7).
+    Sharding is bypassed — the accelerator mesh is exactly what's
+    presumed dead.
     """
     from ..merlin_batch import sr25519_challenges
 
@@ -168,9 +176,15 @@ def verify_batch_sr(pubs, msgs, sigs, ctx: bytes = b"") -> np.ndarray:
         r_pre = np.pad(r_pre, (0, pad))
 
     btab = tv.b_comb_tables()[:_WINDOWS]
-    mesh = tv._mesh()
+    mesh = None if cpu else tv._mesh()
     args = dict(ab=a_raw, rb=r_raw, kdig=kdig, sdig=sdig,
                 a_pre=a_pre, r_pre=r_pre, s_ok=s_ok)
+    if cpu:
+        import jax
+
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            out = _kernel()(btab=btab, **args)
+        return np.asarray(out)[:n] & well_formed
     if (mesh is not None and bucket >= tv._SHARD_MIN
             and bucket % mesh.devices.size == 0):
         import jax

@@ -1,0 +1,110 @@
+"""Device-outage degradation for sr25519 (VERDICT r4 ask #7): when the
+accelerator batch fails, big batches route to the SAME kernel pinned to
+the XLA CPU backend (native code) instead of the ~5.5 ms/sig pure-
+Python oracle, keeping degraded commits at sane cadence on
+sr25519-heavy chains. Reference cost model:
+crypto/sr25519/pubkey.go:34-61 (sequential host verify)."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto import batch as batch_mod
+from tendermint_tpu.crypto import sr25519 as sr_keys
+from tendermint_tpu.crypto import sr25519_ref as sr
+from tendermint_tpu.crypto.tpu import sr_verify
+
+N = 24  # >= batch_mod._CPU_JIT_THRESHOLD_SR
+
+
+def _make_batch(n):
+    minis = [hashlib.sha256(b"deg%d" % i).digest() for i in range(n)]
+    pubs = [sr.public_key_from_mini(m) for m in minis]
+    msgs = [b"degraded vote %d" % i for i in range(n)]
+    sigs = [sr.sign(m, msg) for m, msg in zip(minis, msgs)]
+    return pubs, msgs, sigs
+
+
+def test_cpu_pinned_kernel_matches_oracle():
+    pubs, msgs, sigs = _make_batch(N)
+    bad = bytearray(sigs[5])
+    bad[3] ^= 0xFF
+    sigs[5] = bytes(bad)
+    out = sr_verify.verify_batch_sr(pubs, msgs, sigs, cpu=True)
+    want = np.array([sr.verify(p, m, s)
+                     for p, m, s in zip(pubs, msgs, sigs)])
+    assert (out == want).all() and out.sum() == N - 1
+
+
+def test_device_failure_degrades_to_cpu_jit(monkeypatch):
+    """A failing device launch marks the device down AND the batch
+    still completes through the CPU-jitted kernel (not the per-sig
+    oracle), with correct per-lane verdicts."""
+    pubs, msgs, sigs = _make_batch(N)
+    bad = bytearray(sigs[7])
+    bad[40] ^= 0x01
+    sigs[7] = bytes(bad)
+
+    calls = []
+    real = sr_verify.verify_batch_sr
+
+    def spy(p, m, s, ctx=b"", *, cpu=False):
+        calls.append(cpu)
+        if not cpu:
+            raise RuntimeError("simulated device failure")
+        return real(p, m, s, ctx, cpu=True)
+
+    monkeypatch.setattr(sr_verify, "verify_batch_sr", spy)
+    try:
+        bv = batch_mod.BatchVerifier()
+        for p, m, s in zip(pubs, msgs, sigs):
+            bv.add(sr_keys.Sr25519PubKey(p), m, s)
+        ok, lanes = bv.verify()
+        assert calls == [False, True], calls
+        assert not ok and int(lanes.sum()) == N - 1 and not lanes[7]
+        assert not batch_mod.device_available()  # cooldown armed
+    finally:
+        batch_mod._device_down_until = 0.0
+
+
+def test_explicit_host_mode_keeps_oracle(monkeypatch):
+    """use_device=False callers (oracle tests) must NOT be routed to
+    the CPU-jit path."""
+    pubs, msgs, sigs = _make_batch(batch_mod._CPU_JIT_THRESHOLD_SR)
+
+    def boom(*a, **k):  # any kernel call is a routing bug
+        raise AssertionError("kernel called in host mode")
+
+    monkeypatch.setattr(sr_verify, "verify_batch_sr", boom)
+    bv = batch_mod.BatchVerifier(use_device=False)
+    for p, m, s in zip(pubs, msgs, sigs):
+        bv.add(sr_keys.Sr25519PubKey(p), m, s)
+    ok, lanes = bv.verify()
+    assert ok and lanes.all()
+
+
+@pytest.mark.slow
+def test_degraded_throughput_measured():
+    """The point of the path: CPU-jitted verify must beat the oracle
+    per-sig cost by a wide margin at batch scale (measured, not
+    assumed)."""
+    import time
+
+    n = 256
+    pubs, msgs, sigs = _make_batch(n)
+    sr_verify.verify_batch_sr(pubs, msgs, sigs, cpu=True)  # compile
+    t0 = time.perf_counter()
+    out = sr_verify.verify_batch_sr(pubs, msgs, sigs, cpu=True)
+    per_sig_ms = (time.perf_counter() - t0) * 1e3 / n
+    assert out.all()
+    t0 = time.perf_counter()
+    for i in range(8):
+        sr.verify(pubs[i], msgs[i], sigs[i])
+    oracle_ms = (time.perf_counter() - t0) * 1e3 / 8
+    # Measured on the 1-core CI box: ~3.3 ms/sig CPU-jit vs ~7.5 ms
+    # oracle (2.3x). XLA CPU parallelizes across cores (the oracle
+    # cannot), so real hosts scale ~per-core — the loose 2x bound
+    # keeps a loaded single-core box green while still failing if the
+    # path ever regresses to oracle speed.
+    assert per_sig_ms < oracle_ms / 2, (per_sig_ms, oracle_ms)
