@@ -302,7 +302,7 @@ def cmd_replay_console(args) -> int:
     i = 0
     step = 0
     for seg in segs:
-        for tm in walmod.WAL.decode_all(seg):
+        for tm in walmod.WAL.decode_iter(seg):
             if step <= 0:
                 try:
                     line = input(f"[{i}] Enter=next, N=skip N, "
@@ -395,6 +395,10 @@ def cmd_signer(args) -> int:
     print(f"signer for validator "
           f"{pv.get_pub_key().address().hex()[:12]}… dialing "
           f"{host}:{port}", flush=True)
+    # operators copy this into the node's priv_validator_signer_id to
+    # pin the link (required when the laddr is not loopback-only)
+    print(f"signer link id: "
+          f"{conn_key.pub_key().address().hex()}", flush=True)
     try:
         _asyncio.run(server.dial_and_serve(
             host, port, retries=None, retry_delay=1.0,

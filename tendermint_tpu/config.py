@@ -30,6 +30,13 @@ class BaseConfig:
     # node.go:663). Run the sidecar: `tendermint-tpu signer
     # --connect <this addr>`.
     priv_validator_laddr: str = ""
+    # Pin of the remote signer's LINK identity: hex address of the
+    # signer sidecar's node key (printed by `tendermint-tpu signer` at
+    # startup). Without it, whoever dials priv_validator_laddr first
+    # wins the pinned slot and the real signer is then rejected — a
+    # liveness attack if the laddr is reachable beyond loopback. Set
+    # this whenever priv_validator_laddr is not loopback/firewalled.
+    priv_validator_signer_id: str = ""
     node_key_file: str = "config/node_key.json"
     abci: str = "builtin"  # builtin | socket | grpc
     proxy_app: str = "kvstore"

@@ -265,15 +265,12 @@ class WAL:
     # -- reading --
 
     @staticmethod
-    def _decode_file(path: str,
-                     strict: bool = False
-                     ) -> tuple[list[TimedWALMessage], int, int]:
-        """Read every record; returns (messages, consumed_bytes,
-        file_size). On a corrupt/torn record, stop (strict=False —
-        crash tails are expected) or raise (strict=True)."""
-        out: list[TimedWALMessage] = []
+    def _iter_records(path: str, strict: bool = False):
+        """Yield (TimedWALMessage, consumed_bytes_after) one record at
+        a time. On a corrupt/torn record, stop (strict=False — crash
+        tails are expected) or raise (strict=True)."""
         if not os.path.exists(path):
-            return out, 0, 0
+            return
         with open(path, "rb") as f:
             data = f.read()
         pos = 0
@@ -282,24 +279,45 @@ class WAL:
             if ln > MAX_MSG_SIZE:
                 if strict:
                     raise WALCorruptionError(f"record length {ln} too big")
-                break
+                return
             body = data[pos + _FRAME.size : pos + _FRAME.size + ln]
             if len(body) < ln or zlib.crc32(body) != crc:
                 if strict:
                     raise WALCorruptionError("crc mismatch / torn record")
-                break
+                return
             try:
-                out.append(_decode_wal_msg(body))
+                msg = _decode_wal_msg(body)
             except ValueError:
                 if strict:
                     raise
-                break
+                return
             pos += _FRAME.size + ln
-        return out, pos, len(data)
+            yield msg, pos
+
+    @staticmethod
+    def _decode_file(path: str,
+                     strict: bool = False
+                     ) -> tuple[list[TimedWALMessage], int, int]:
+        """Every record of one file; returns (messages,
+        consumed_bytes, file_size)."""
+        out: list[TimedWALMessage] = []
+        pos = 0
+        for msg, pos in WAL._iter_records(path, strict):
+            out.append(msg)
+        size = os.path.getsize(path) if os.path.exists(path) else 0
+        return out, pos, size
 
     @staticmethod
     def decode_all(path: str, strict: bool = False) -> list[TimedWALMessage]:
         return WAL._decode_file(path, strict)[0]
+
+    @staticmethod
+    def decode_iter(path: str, strict: bool = False):
+        """Record-at-a-time generator: peak memory is one segment's
+        raw bytes + ONE decoded message (decode_all materializes the
+        whole list — wrong for the replay console over a big WAL)."""
+        for msg, _ in WAL._iter_records(path, strict):
+            yield msg
 
     def _read_segment(self, path: str) -> list[TimedWALMessage]:
         """One segment's valid records. Rotated segments were sealed

@@ -216,8 +216,11 @@ class Node(Service):
 
             host, port = _split_laddr(cfg.base.priv_validator_laddr,
                                       default_host="127.0.0.1")
+            pin = cfg.base.priv_validator_signer_id.strip()
             sc = SignerClient(self.genesis_doc.chain_id, timeout=30.0,
-                              conn_key=self.node_key.priv_key)
+                              conn_key=self.node_key.priv_key,
+                              expected_signer_addr=(
+                                  bytes.fromhex(pin) if pin else None))
             bound = await sc.listen(host, port)
             while True:
                 logger.info("waiting for remote signer on %s:%s",

@@ -22,16 +22,27 @@ PEX_CHANNEL = 0x00
 _MSG_REQUEST = "pex_request"
 _MSG_ADDRS = "pex_addrs"
 
-_REQUEST_INTERVAL = 60.0     # receiver: min seconds between requests
-# Sender-side spacing must EXCEED the receiver's bar with margin, and
-# must survive reconnects: in a small net the book never fills, the
-# ensure loop re-requests forever, and `_requested` used to reset on
-# every reconnect — two innocent requests < 60s apart made the
-# receiver stop the connection, the churn reset the guard, and the
-# whole net degenerated into mutual flood-flagging (observed starving
-# a kill -9'd node's catch-up for 9+ minutes in a soak run).
-_REQUEST_SEND_SPACING = 90.0
+# Request rate limits SCALE with ensure_period (one knob; prod default
+# 30 s -> receiver bar 60 s, sender spacing 90 s — the reference's
+# fixed numbers). Sender-side spacing exceeds the receiver's bar with
+# margin, and must survive reconnects: in a small net the book never
+# fills, the ensure loop re-requests forever, and `_requested` used to
+# reset on every reconnect — two innocent requests under the receiver
+# bar once degenerated the whole net into mutual flood-flagging
+# (observed starving a kill -9'd node's catch-up for 9+ minutes in a
+# soak run). Tests that set pex_ensure_period_s get proportional
+# limits for free instead of needing a second knob.
+#
+# The sender/receiver invariant only holds when peers run comparable
+# ensure_periods, so over-rate requests are NOT immediately fatal: the
+# receiver IGNORES mildly-early requests (a peer with a faster local
+# config just gets no answer) and only flags a flood after
+# _FLOOD_STRIKES over-rate requests inside one bar — keeping the DoS
+# guard without letting config skew sever healthy links.
 _ENSURE_PERIOD = 30.0
+_REQUEST_INTERVAL_FACTOR = 2.0   # receiver: min seconds between reqs
+_REQUEST_SPACING_FACTOR = 3.0    # sender: spacing > receiver bar
+_FLOOD_STRIKES = 3
 
 
 class PEXReactor(Reactor):
@@ -43,7 +54,11 @@ class PEXReactor(Reactor):
         self.seed_mode = seed_mode
         self.seeds = seeds or []
         self.ensure_period = ensure_period
+        self.request_interval = _REQUEST_INTERVAL_FACTOR * ensure_period
+        self.request_send_spacing = \
+            _REQUEST_SPACING_FACTOR * ensure_period
         self._last_request_from: dict[str, float] = {}
+        self._flood_strikes: dict[str, int] = {}
         self._requested: set[str] = set()
         # NOT cleared on remove_peer: rate limit outlives reconnects
         self._last_request_to: dict[str, float] = {}
@@ -85,9 +100,13 @@ class PEXReactor(Reactor):
         listen = listen[len("tcp://"):] if listen.startswith("tcp://") \
             else listen
         host, _, port = listen.rpartition(":")
+        # bracketed IPv6 ("[::]:26656", "[fe80::1]:26656"): the book
+        # and dialer use unbracketed hosts with last-colon splits
+        host = host.strip("[]")
         if port.isdigit():
             if host in ("", "0.0.0.0", "::"):
-                host = (peer.socket_addr or "").rsplit(":", 1)[0]
+                host = (peer.socket_addr or "") \
+                    .rsplit(":", 1)[0].strip("[]")
             if host:
                 self.book.add_address(f"{peer.id}@{host}:{port}",
                                       src=peer.id)
@@ -97,6 +116,7 @@ class PEXReactor(Reactor):
     async def remove_peer(self, peer, reason) -> None:
         self._requested.discard(peer.id)
         self._last_request_from.pop(peer.id, None)
+        self._flood_strikes.pop(peer.id, None)
 
     async def receive(self, chan_id: int, peer, msg: bytes) -> None:
         d = json.loads(msg)
@@ -104,8 +124,13 @@ class PEXReactor(Reactor):
         if t == _MSG_REQUEST:
             now = time.monotonic()
             last = self._last_request_from.get(peer.id, 0.0)
-            if now - last < _REQUEST_INTERVAL and not self.seed_mode:
-                raise ValueError("pex request flood")
+            if now - last < self.request_interval and not self.seed_mode:
+                strikes = self._flood_strikes.get(peer.id, 0) + 1
+                self._flood_strikes[peer.id] = strikes
+                if strikes >= _FLOOD_STRIKES:
+                    raise ValueError("pex request flood")
+                return  # mildly early (config skew): ignore, no answer
+            self._flood_strikes.pop(peer.id, None)
             self._last_request_from[peer.id] = now
             sel = self.book.get_selection()
             await peer.send(PEX_CHANNEL, json.dumps(
@@ -139,7 +164,7 @@ class PEXReactor(Reactor):
     async def _request_addrs(self, peer) -> None:
         now = time.monotonic()
         if now - self._last_request_to.get(peer.id, -1e9) < \
-                _REQUEST_SEND_SPACING:
+                self.request_send_spacing:
             return  # receiver would (rightly) flag us as flooding
         self._last_request_to[peer.id] = now
         self._requested.add(peer.id)

@@ -343,9 +343,7 @@ def test_pex_request_rate_limit_survives_reconnect():
         await rx._request_addrs(peer)
         assert len(peer.sent) == 1
         # after the spacing elapses, requests flow again
-        from tendermint_tpu.p2p.pex import reactor as rmod
-
-        rx._last_request_to[peer.id] -= rmod._REQUEST_SEND_SPACING + 1
+        rx._last_request_to[peer.id] -= rx.request_send_spacing + 1
         await rx._request_addrs(peer)
         assert len(peer.sent) == 2
 
@@ -468,3 +466,47 @@ def test_switch_peer_filter_rejects():
         await sw1.stop(); await sw2.stop()
 
     run(go())
+
+
+def test_pex_receiver_tolerates_skew_then_flags_flood():
+    """Over-rate PEX requests (a peer with a faster local
+    pex_ensure_period_s) are IGNORED — no answer, no disconnect — and
+    only repeated over-rate requests inside one bar raise the flood
+    error. Keeps the DoS guard without letting config skew sever
+    healthy links (round-5 review finding)."""
+    import asyncio as aio
+    import json as _json
+
+    import pytest as _pytest
+
+    from tendermint_tpu.p2p.pex.addrbook import AddrBook
+    from tendermint_tpu.p2p.pex.reactor import PEX_CHANNEL, PEXReactor
+
+    class FakePeer:
+        def __init__(self, pid):
+            self.id = pid
+            self.outbound = False
+            self.socket_addr = ""
+            self.sent = []
+
+        async def send(self, chan, msg):
+            self.sent.append(msg)
+
+    async def go():
+        rx = PEXReactor(AddrBook())
+        peer = FakePeer("cd" * 20)
+        req = _json.dumps({"type": "pex_request"}).encode()
+        await rx.receive(PEX_CHANNEL, peer, req)   # in-rate: answered
+        assert len(peer.sent) == 1
+        await rx.receive(PEX_CHANNEL, peer, req)   # strike 1: ignored
+        await rx.receive(PEX_CHANNEL, peer, req)   # strike 2: ignored
+        assert len(peer.sent) == 1
+        with _pytest.raises(ValueError, match="flood"):
+            await rx.receive(PEX_CHANNEL, peer, req)  # strike 3: flagged
+        # a well-spaced request clears the strikes
+        rx._last_request_from[peer.id] -= rx.request_interval + 1
+        rx._flood_strikes.pop(peer.id, None)  # peer was dropped; fresh conn
+        await rx.receive(PEX_CHANNEL, peer, req)
+        assert len(peer.sent) == 2 and peer.id not in rx._flood_strikes
+
+    aio.run(go())
