@@ -7,8 +7,10 @@ Reference: node/node.go:807-812 serves net/http/pprof on
   GET /debug/pprof/            index
   GET /debug/pprof/goroutine   all asyncio tasks + thread stacks
                                (the goroutine-dump analogue)
-  GET /debug/pprof/heap        tracemalloc top allocations (starts
-                               tracemalloc on first call)
+  GET /debug/pprof/heap?seconds=N
+                               tracemalloc top allocations sampled
+                               over an N-second window (default 0.5;
+                               tracing is stopped afterwards)
   GET /debug/pprof/profile?seconds=N
                                cProfile the event loop process for N
                                seconds, return pstats text
@@ -50,20 +52,47 @@ def _goroutine_dump() -> str:
     return out.getvalue()
 
 
-def _heap_dump() -> str:
+async def _heap_dump(window_s: float = 0.5) -> str:
+    """Windowed tracemalloc sample. tracemalloc MUST NOT be left
+    running after the request: it hooks every allocation and slows
+    the whole process 3-4x — a single `debug dump` poll used to
+    permanently degrade the node it was diagnosing (found when the
+    test suite's post-/heap tests all ran ~4x slower). Operators who
+    want cumulative tracing can start the process with
+    PYTHONTRACEMALLOC=1; tracing that was already on stays on."""
     import tracemalloc
 
-    if not tracemalloc.is_tracing():
-        tracemalloc.start()
-        return ("tracemalloc just started; call again after some "
-                "allocations for a meaningful snapshot\n")
-    snap = tracemalloc.take_snapshot()
     out = io.StringIO()
-    current, peak = tracemalloc.get_traced_memory()
-    out.write(f"traced current={current} peak={peak}\n\n")
-    for stat in snap.statistics("lineno")[:50]:
-        out.write(f"{stat}\n")
+    started_here = not tracemalloc.is_tracing()
+    if started_here:
+        tracemalloc.start()
+        await asyncio.sleep(window_s)
+        out.write(f"allocations sampled over a {window_s:.1f}s window "
+                  "(tracemalloc stopped after the snapshot; start the "
+                  "process with PYTHONTRACEMALLOC=1 for cumulative "
+                  "tracing)\n")
+    try:
+        snap = tracemalloc.take_snapshot()
+        current, peak = tracemalloc.get_traced_memory()
+        out.write(f"traced current={current} peak={peak}\n\n")
+        for stat in snap.statistics("lineno")[:50]:
+            out.write(f"{stat}\n")
+    finally:
+        if started_here:
+            tracemalloc.stop()
     return out.getvalue()
+
+
+def _parse_seconds(raw, default: float, cap: float) -> float:
+    """Query-param seconds: garbage/NaN/negative must degrade to the
+    default, never into asyncio.sleep (a NaN timer hangs the request)."""
+    try:
+        v = float(raw) if raw is not None else default
+    except ValueError:
+        return default
+    if not (0.0 <= v):  # catches NaN too
+        return default
+    return min(v, cap)
 
 
 async def _profile(seconds: float) -> str:
@@ -129,14 +158,15 @@ class DebugServer:
 
     async def _route(self, path: str, params: dict) -> bytes:
         if path in ("/debug/pprof", "/debug/pprof/"):
-            return (b"pprof endpoints: goroutine, heap, profile?seconds=N; "
-                    b"also /metrics\n")
+            return (b"pprof endpoints: goroutine, heap?seconds=N, "
+                    b"profile?seconds=N; also /metrics\n")
         if path == "/debug/pprof/goroutine":
             return _goroutine_dump().encode()
         if path == "/debug/pprof/heap":
-            return _heap_dump().encode()
+            secs = _parse_seconds(params.get("seconds"), 0.5, cap=10.0)
+            return (await _heap_dump(secs)).encode()
         if path == "/debug/pprof/profile":
-            secs = float(params.get("seconds", "5"))
+            secs = _parse_seconds(params.get("seconds"), 5.0, cap=60.0)
             return (await _profile(secs)).encode()
         if path == "/metrics":
             from .metrics import DEFAULT

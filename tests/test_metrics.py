@@ -95,8 +95,15 @@ def test_debug_server_routes():
         assert b"pprof endpoints" in idx
         goro = await get("/debug/pprof/goroutine")
         assert b"asyncio tasks" in goro
-        heap = await get("/debug/pprof/heap")
-        assert b"tracemalloc" in heap
+        heap = await get("/debug/pprof/heap?seconds=0.1")
+        assert b"traced current=" in heap
+        # REGRESSION GUARD: the heap route must not leave tracemalloc
+        # running — it slows the whole process 3-4x (one debug-dump
+        # poll used to permanently degrade the node AND every
+        # kernel-compile test that ran after this one in the suite).
+        import tracemalloc
+
+        assert not tracemalloc.is_tracing()
         met = await get("/metrics")
         assert b"# TYPE" in met
         srv.close()
