@@ -485,7 +485,22 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tendermint-tpu",
                                 description=__doc__)
     p.add_argument("--home", default=os.path.expanduser("~/.tendermint_tpu"))
-    sub = p.add_subparsers(dest="command")
+    _sub = p.add_subparsers(dest="command")
+
+    # --home works in BOTH positions (`--home H start` and
+    # `start --home H`), like cobra persistent flags: every subparser
+    # inherits it via a parent with SUPPRESS so an omitted
+    # subcommand-level flag never clobbers the top-level value.
+    _home_parent = argparse.ArgumentParser(add_help=False)
+    _home_parent.add_argument("--home", default=argparse.SUPPRESS)
+
+    class _Sub:
+        def add_parser(self, name, **kw):
+            # fresh list: never mutate a caller-shared parents list
+            kw["parents"] = [*kw.get("parents", []), _home_parent]
+            return _sub.add_parser(name, **kw)
+
+    sub = _Sub()
 
     sp = sub.add_parser("init", help="initialize a home directory")
     sp.add_argument("--chain-id", default="")
