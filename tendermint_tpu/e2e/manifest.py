@@ -146,6 +146,30 @@ class Manifest:
             if self.late_statesync_node:
                 raise ValueError(
                     "late_statesync_node requires abci = \"builtin\"")
+        if self.late_statesync_node and self.nodes < 4:
+            # the held-back node is a validator: with n equal-power
+            # validators the remaining (n-1)/n must EXCEED 2/3, so
+            # n=3 leaves exactly 2/3 and the net can never commit
+            # (found by the randomized manifest campaign, seed 4)
+            raise ValueError("late_statesync_node requires nodes >= 4")
+        if self.late_statesync_node and self.validator_updates:
+            # While the last node is held back, every intermediate
+            # validator set the update schedule produces must keep
+            # the LIVE power strictly above 2/3 of the total, or the
+            # net deadlocks before the late joiner can sync. Genesis
+            # power is the testnet generator's 10 per validator.
+            powers = {i: 10 for i in range(self.nodes)}
+            held = self.nodes - 1
+            for vu in sorted(self.validator_updates,
+                             key=lambda v: v.at_height):
+                powers[vu.node] = vu.power
+                total = sum(powers.values())
+                live = total - powers.get(held, 0)
+                if live * 3 <= total * 2:
+                    raise ValueError(
+                        f"validator_update at height {vu.at_height} "
+                        f"leaves live power {live}/{total} <= 2/3 "
+                        "while the late_statesync node is held back")
         if self.wait_height < 1:
             raise ValueError("wait_height must be >= 1")
         for p in self.perturbations:
