@@ -34,10 +34,10 @@ from __future__ import annotations
 
 import base64
 import json
-from datetime import datetime, timezone
 from fractions import Fraction
 
 from ..crypto import ed25519
+from ..libs.timeenc import rfc3339_to_ns as _time_ns
 from ..types.block import (
     BlockID,
     BlockIDFlag,
@@ -60,15 +60,6 @@ INVALID = "INVALID"
 MAX_CLOCK_DRIFT_NS = 1_000_000_000
 
 
-def _time_ns(s: str) -> int:
-    """RFC3339 with up to nanosecond fraction -> unix ns."""
-    base, _, frac = s.rstrip("Z").partition(".")
-    dt = datetime.strptime(base, "%Y-%m-%dT%H:%M:%S").replace(
-        tzinfo=timezone.utc)
-    ns = int(dt.timestamp()) * 1_000_000_000
-    if frac:
-        ns += int(frac.ljust(9, "0")[:9])
-    return ns
 
 
 def _hex(s: str | None) -> bytes:

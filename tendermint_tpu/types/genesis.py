@@ -13,6 +13,27 @@ from .validator import Validator
 
 MAX_CHAIN_ID_LEN = 50
 
+# Reference tmjson key-type tags (crypto/encoding + amino-era names)
+_REF_KEY_TYPES = {
+    "tendermint/PubKeyEd25519": "ed25519",
+    "tendermint/PubKeySecp256k1": "secp256k1",
+    "tendermint/PubKeySr25519": "sr25519",
+}
+
+
+def _pub_key_from_json(pk: dict) -> "crypto.PubKey":
+    """{'type','value'} with either repo conventions (short type name,
+    hex value) or reference tmjson (amino-style tag, base64 value)."""
+    tname = _REF_KEY_TYPES.get(pk["type"], pk["type"])
+    raw = pk["value"]
+    try:
+        return crypto.pubkey_from_type_and_bytes(tname, bytes.fromhex(raw))
+    except ValueError:
+        import base64
+
+        return crypto.pubkey_from_type_and_bytes(
+            tname, base64.b64decode(raw))
+
 
 @dataclass
 class GenesisValidator:
@@ -85,23 +106,30 @@ class GenesisDoc:
 
     @classmethod
     def from_json(cls, s: str) -> "GenesisDoc":
+        """Accepts this repo's JSON AND the reference's tmjson format
+        (types/genesis.go: RFC3339 genesis_time, string-encoded
+        int64s, 'tendermint/PubKeyEd25519'-style key types with base64
+        values) — a reference operator's genesis.json loads unchanged."""
         d = json.loads(s)
+        gt = d.get("genesis_time", 0)
+        if isinstance(gt, str):
+            from ..libs.timeenc import rfc3339_to_ns
+
+            gt = rfc3339_to_ns(gt)
         doc = cls(
             chain_id=d["chain_id"],
-            genesis_time=d.get("genesis_time", 0),
-            initial_height=d.get("initial_height", 1),
+            genesis_time=gt,
+            initial_height=int(d.get("initial_height") or 1),
             consensus_params=ConsensusParams.from_json(
-                d.get("consensus_params", {})
+                d.get("consensus_params")
             ),
             validators=[
                 GenesisValidator(
-                    pub_key=crypto.pubkey_from_type_and_bytes(
-                        gv["pub_key"]["type"], bytes.fromhex(gv["pub_key"]["value"])
-                    ),
-                    power=gv["power"],
-                    name=gv.get("name", ""),
+                    pub_key=_pub_key_from_json(gv["pub_key"]),
+                    power=int(gv["power"]),
+                    name=gv.get("name") or "",
                 )
-                for gv in d.get("validators", [])
+                for gv in d.get("validators") or []
             ],
             app_hash=bytes.fromhex(d.get("app_hash", "")),
             app_state=d.get("app_state"),

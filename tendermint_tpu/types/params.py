@@ -88,10 +88,35 @@ class ConsensusParams:
         return asdict(self)
 
     @classmethod
-    def from_json(cls, d: dict) -> "ConsensusParams":
+    def from_json(cls, d: dict | None) -> "ConsensusParams":
+        """Accepts both this repo's JSON and the reference's tmjson
+        (string-encoded int64s, `max_age_duration`, null params —
+        types/genesis.go ConsensusParams)."""
+        import dataclasses
+
+        d = d or {}
+
+        def sec(name, klass, renames=()):
+            raw = dict(d.get(name) or {})
+            fields = {f.name for f in dataclasses.fields(klass)}
+            out = {}
+            for k, v in raw.items():
+                k = dict(renames).get(k, k)
+                if k not in fields:
+                    # loud, not silent: a typo'd knob running with its
+                    # default would be a config the operator didn't ask
+                    # for (every reference tmjson key maps via renames)
+                    raise ValueError(
+                        f"unknown consensus_params.{name} key {k!r}")
+                if isinstance(v, str) and v.lstrip("-").isdigit():
+                    v = int(v)
+                out[k] = v
+            return klass(**out)
+
         return cls(
-            block=BlockParams(**d.get("block", {})),
-            evidence=EvidenceParams(**d.get("evidence", {})),
-            validator=ValidatorParams(**d.get("validator", {})),
-            version=VersionParams(**d.get("version", {})),
+            block=sec("block", BlockParams),
+            evidence=sec("evidence", EvidenceParams,
+                         (("max_age_duration", "max_age_duration_ns"),)),
+            validator=sec("validator", ValidatorParams),
+            version=sec("version", VersionParams),
         )

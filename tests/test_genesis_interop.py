@@ -1,0 +1,113 @@
+"""Reference-format genesis.json interop (reference: types/genesis.go
+GenesisDocFromJSON + genesis_test.go TestGenesisGood): a genesis file
+written by the reference toolchain loads unchanged — RFC3339 times,
+string int64s, amino-style pub_key type tags with base64 values,
+tmjson consensus params (incl. max_age_duration)."""
+
+import json
+
+from tendermint_tpu.types.genesis import GenesisDoc
+
+# the reference's own "good" fixture (genesis_test.go:63-76), plus the
+# consensus_params shape tmjson emits
+REF_GENESIS = """{
+  "genesis_time": "2020-10-21T08:44:52.160326989Z",
+  "chain_id": "test-chain-QDKdJr",
+  "initial_height": "1000",
+  "consensus_params": {
+    "block": {"max_bytes": "22020096", "max_gas": "-1",
+              "time_iota_ms": "1000"},
+    "evidence": {"max_age_num_blocks": "100000",
+                 "max_age_duration": "172800000000000",
+                 "max_bytes": "1048576"},
+    "validator": {"pub_key_types": ["ed25519"]},
+    "version": {}
+  },
+  "validators": [{
+    "address": "013EFE69A2F5781D38EFB32E77D24C9BC4A1F012",
+    "pub_key": {"type": "tendermint/PubKeyEd25519",
+                "value": "AT/+aaL1eB0477Mud9JMm8Sh8BIvOYlPGC9KkIUmFaE="},
+    "power": "10",
+    "name": ""
+  }],
+  "app_hash": "",
+  "app_state": {"account_owner": "Bob"}
+}"""
+
+
+def test_reference_genesis_loads():
+    doc = GenesisDoc.from_json(REF_GENESIS)
+    assert doc.chain_id == "test-chain-QDKdJr"
+    assert doc.initial_height == 1000
+    assert doc.genesis_time == 1603269892160326989
+    assert doc.consensus_params.block.max_bytes == 22020096
+    assert doc.consensus_params.block.max_gas == -1
+    assert doc.consensus_params.evidence.max_age_duration_ns == \
+        172800000000000
+    assert len(doc.validators) == 1
+    v = doc.validators[0]
+    assert v.power == 10 and v.pub_key.type_name == "ed25519"
+    assert doc.app_state == {"account_owner": "Bob"}
+
+
+def test_null_consensus_params_and_zero_time():
+    doc = GenesisDoc.from_json(json.dumps({
+        "genesis_time": "0001-01-01T00:00:00Z",
+        "chain_id": "abc",
+        "consensus_params": None,
+        "validators": [{
+            "pub_key": {"type": "tendermint/PubKeyEd25519",
+                        "value": "AT/+aaL1eB0477Mud9JMm8Sh8BIvOYlPGC9KkIUmFaE="},
+            "power": "10", "name": "myval"
+        }],
+    }))
+    # Go zero time is pre-1970; validate_and_complete only replaces 0
+    assert doc.genesis_time < 0
+    assert doc.consensus_params.block.max_bytes == 22020096  # defaults
+
+
+def test_repo_format_round_trips_unchanged():
+    doc = GenesisDoc.from_json(REF_GENESIS)
+    again = GenesisDoc.from_json(doc.to_json())
+    assert again.hash() == doc.hash()
+    assert again.validators[0].pub_key.bytes() == \
+        doc.validators[0].pub_key.bytes()
+    assert again.genesis_time == doc.genesis_time
+
+
+def test_rfc3339_round_trip():
+    from tendermint_tpu.libs.timeenc import ns_to_rfc3339, rfc3339_to_ns
+
+    for s, ns in (("2020-10-21T08:44:52.160326989Z", 1603269892160326989),
+                  ("1970-01-01T00:00:01Z", 1_000_000_000),
+                  ("1970-01-01T00:00:00.5Z", 500_000_000)):
+        assert rfc3339_to_ns(s) == ns
+        assert rfc3339_to_ns(ns_to_rfc3339(ns)) == ns
+
+
+def test_rfc3339_offsets_and_edge_cases():
+    import pytest as _pytest
+
+    from tendermint_tpu.libs.timeenc import ns_to_rfc3339, rfc3339_to_ns
+
+    # numeric UTC offsets (Go emits them for non-UTC locations)
+    assert rfc3339_to_ns("2020-10-21T10:44:52.160326989+02:00") == \
+        1603269892160326989
+    assert rfc3339_to_ns("2020-10-21T06:44:52-02:00") == \
+        rfc3339_to_ns("2020-10-21T08:44:52Z")
+    # Go zero time round-trips as valid zero-padded RFC3339
+    zero_ns = rfc3339_to_ns("0001-01-01T00:00:00Z")
+    assert zero_ns < 0
+    assert ns_to_rfc3339(zero_ns) == "0001-01-01T00:00:00Z"
+    assert rfc3339_to_ns(ns_to_rfc3339(zero_ns)) == zero_ns
+    with _pytest.raises(ValueError):
+        rfc3339_to_ns("yesterday at noon")
+
+
+def test_unknown_consensus_param_key_rejected():
+    import pytest as _pytest
+
+    from tendermint_tpu.types.params import ConsensusParams
+
+    with _pytest.raises(ValueError, match="max_bytez"):
+        ConsensusParams.from_json({"block": {"max_bytez": 5}})
