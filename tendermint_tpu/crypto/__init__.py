@@ -70,6 +70,24 @@ def pubkey_from_type_and_bytes(type_name: str, data: bytes) -> PubKey:
     return cls(data)
 
 
+def ed25519_privkey_from_json(raw, what: str) -> "PrivKey":
+    """One parse for the repo's flat-hex key files AND the reference's
+    tmjson form ({'type': 'tendermint/PrivKeyEd25519', 'value':
+    base64 of seed||pub}). The tag match is EXACT: a pubkey-tagged
+    dict fed here would otherwise treat a 32-byte public key as a
+    seed and silently boot under a brand-new identity."""
+    from . import ed25519
+
+    if isinstance(raw, dict):  # reference tmjson
+        tag = raw.get("type", "")
+        if tag not in ("tendermint/PrivKeyEd25519", "ed25519"):
+            raise ValueError(f"unsupported {what} key type {tag!r}")
+        import base64
+
+        return ed25519.Ed25519PrivKey(base64.b64decode(raw["value"]))
+    return ed25519.Ed25519PrivKey(bytes.fromhex(raw))
+
+
 def _ensure_registered() -> None:
     """Import every key-type module so its register_pubkey ran
     (reference key-type set: ed25519, sr25519, secp256k1 —

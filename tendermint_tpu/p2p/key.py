@@ -43,9 +43,14 @@ class NodeKey:
 
     @classmethod
     def load(cls, path: str) -> "NodeKey":
+        """Accepts repo flat-hex AND the reference's tmjson node key
+        (p2p/key.go: {'priv_key': {'type': 'tendermint/PrivKeyEd25519',
+        'value': base64}}) — node identity migrates unchanged."""
+        from ..crypto import ed25519_privkey_from_json
+
         with open(path) as f:
             d = json.load(f)
-        return cls(Ed25519PrivKey(bytes.fromhex(d["priv_key"])))
+        return cls(ed25519_privkey_from_json(d["priv_key"], "node"))
 
     def save(self, path: str) -> None:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

@@ -94,9 +94,15 @@ class FilePV:
 
     @classmethod
     def load(cls, key_path: str, state_path: str) -> "FilePV":
+        """Accepts this repo's flat-hex format AND the reference's
+        tmjson (privval/file.go FilePVKey: nested
+        {'type': 'tendermint/PrivKeyEd25519', 'value': base64 of
+        seed||pub}) — a reference validator key migrates unchanged."""
+        from ..crypto import ed25519_privkey_from_json
+
         with open(key_path) as f:
             d = json.load(f)
-        return cls(ed25519.Ed25519PrivKey(bytes.fromhex(d["priv_key"])),
+        return cls(ed25519_privkey_from_json(d["priv_key"], "privval"),
                    key_path, state_path)
 
     @classmethod
@@ -120,12 +126,27 @@ class FilePV:
         os.replace(tmp, self.key_path)
 
     def _load_state(self) -> None:
+        """Accepts repo format and reference tmjson
+        (privval/file.go FilePVLastSignState: string height, base64
+        signature, 'signbytes' hex) — last-sign state migrates too, so
+        double-sign protection survives the switch."""
         with open(self.state_path) as f:
             d = json.load(f)
+
+        def sig_bytes(raw: str) -> bytes:
+            try:
+                return bytes.fromhex(raw)
+            except ValueError:
+                import base64
+
+                return base64.b64decode(raw)
+
         self.last_sign_state = LastSignState(
-            height=d["height"], round=d["round"], step=d["step"],
-            signature=bytes.fromhex(d.get("signature", "")),
-            sign_bytes=bytes.fromhex(d.get("sign_bytes", "")),
+            height=int(d["height"]), round=int(d["round"]),
+            step=int(d["step"]),
+            signature=sig_bytes(d.get("signature") or ""),
+            sign_bytes=bytes.fromhex(
+                d.get("sign_bytes") or d.get("signbytes") or ""),
         )
 
     def _save_state(self) -> None:

@@ -111,3 +111,69 @@ def test_unknown_consensus_param_key_rejected():
 
     with _pytest.raises(ValueError, match="max_bytez"):
         ConsensusParams.from_json({"block": {"max_bytez": 5}})
+
+
+def test_reference_key_files_load(tmp_path):
+    """Reference-format priv_validator_key.json / state / node_key.json
+    load unchanged (privval/file.go FilePVKey + FilePVLastSignState,
+    p2p/key.go NodeKey) — the full key-migration surface."""
+    import base64
+    import hashlib
+
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.privval import FilePV
+
+    seed = hashlib.sha256(b"migrate").digest()
+    from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+
+    k = Ed25519PrivKey(seed)
+    pub = k.pub_key().bytes()
+    full = seed + pub  # Go ed25519.PrivateKey = seed||pub, 64 bytes
+
+    kp = tmp_path / "priv_validator_key.json"
+    kp.write_text(json.dumps({
+        "address": k.pub_key().address().hex().upper(),
+        "pub_key": {"type": "tendermint/PubKeyEd25519",
+                    "value": base64.b64encode(pub).decode()},
+        "priv_key": {"type": "tendermint/PrivKeyEd25519",
+                     "value": base64.b64encode(full).decode()},
+    }))
+    sp = tmp_path / "priv_validator_state.json"
+    sp.write_text(json.dumps({
+        "height": "42", "round": 1, "step": 3,
+        "signature": base64.b64encode(b"\x01" * 64).decode(),
+        "signbytes": (b"\x02" * 10).hex().upper(),
+    }))
+    pv = FilePV.load(str(kp), str(sp))
+    assert pv.get_pub_key().bytes() == pub
+    lss = pv.last_sign_state
+    assert (lss.height, lss.round, lss.step) == (42, 1, 3)
+    assert lss.signature == b"\x01" * 64
+    assert lss.sign_bytes == b"\x02" * 10
+
+    nkp = tmp_path / "node_key.json"
+    nkp.write_text(json.dumps({
+        "priv_key": {"type": "tendermint/PrivKeyEd25519",
+                     "value": base64.b64encode(full).decode()},
+    }))
+    nk = NodeKey.load(str(nkp))
+    assert nk.priv_key.pub_key().bytes() == pub
+
+
+def test_pubkey_tagged_privkey_rejected(tmp_path):
+    """A priv_key field holding a PUBKEY-tagged dict must fail loudly,
+    not boot under a silently-derived new identity."""
+    import base64
+    import hashlib
+
+    import pytest as _pytest
+
+    from tendermint_tpu.privval import FilePV
+
+    pub32 = hashlib.sha256(b"not a seed").digest()
+    kp = tmp_path / "k.json"
+    kp.write_text(json.dumps({"priv_key": {
+        "type": "tendermint/PubKeyEd25519",
+        "value": base64.b64encode(pub32).decode()}}))
+    with _pytest.raises(ValueError, match="PubKeyEd25519"):
+        FilePV.load(str(kp), str(tmp_path / "s.json"))
