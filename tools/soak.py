@@ -49,7 +49,8 @@ def main() -> int:
     for k in range(int(minutes)):
         perturbs.append({
             "node": k % nodes,
-            "op": ("kill", "pause", "restart", "disconnect")[k % 4],
+            "op": ("kill", "pause", "restart", "disconnect",
+                   "disconnect_hard")[k % 5],
             "at_height": 5 + k * max(5, total_h // max(int(minutes), 1)),
             "duration": 3.0,
         })
