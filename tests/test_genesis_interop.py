@@ -177,3 +177,34 @@ def test_pubkey_tagged_privkey_rejected(tmp_path):
         "value": base64.b64encode(pub32).decode()}}))
     with _pytest.raises(ValueError, match="PubKeyEd25519"):
         FilePV.load(str(kp), str(tmp_path / "s.json"))
+
+
+def test_encoding_golden_pins_self_contained():
+    """Golden pins for the corpus-validated canonical encodings —
+    EXACT values cross-checked against the reference's TLA+ MBT corpus
+    (tests/test_light_mbt_ref.py needs /root/reference; these pins
+    hold the same bytes without it). Any drift here breaks interop
+    with reference-format chains."""
+    import base64
+
+    from tendermint_tpu.crypto.ed25519 import Ed25519PubKey
+    from tendermint_tpu.types.block import zero_block_id_bytes
+    from tendermint_tpu.types.validator import Validator
+    from tendermint_tpu.types.validator_set import ValidatorSet
+
+    # gogo non-nullable part_set_header: zero BlockID is 0x1200
+    assert zero_block_id_bytes() == bytes([0x12, 0x00])
+
+    # SimpleValidator leaf + valset hash pinned from
+    # MC4_4_faulty_TestSuccess.json input[0].validator_set
+    pub = Ed25519PubKey(base64.b64decode(
+        "kwd8trZ8t5ASwgUbBEAnDq49nRRrrKvt2onhS4JSfQM="))
+    v = Validator(address=pub.address(), pub_key=pub, voting_power=50)
+    assert v.bytes_for_hash().hex() == (
+        "0a220a20" + pub.bytes().hex() + "1032")
+    vs = ValidatorSet([v])
+    # == MC4_4_faulty_TestFailure.json initial header's
+    # next_validators_hash (the next valset is exactly this one
+    # 50-power validator)
+    assert vs.hash().hex().upper() == (
+        "C8F8530F1A2E69409F2E0B4F86BB568695BC9790BA77EAC1505600D5506E22DA")
