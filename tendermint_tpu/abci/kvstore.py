@@ -26,11 +26,24 @@ def encode_validator_tx(pub_key_hex: str, power: int) -> bytes:
 
 
 class KVStoreApp(t.Application):
+    """DeliverTx applies immediately (reference kvstore.go behavior —
+    queries see uncommitted writes, as the abci-cli goldens capture)
+    but every write is journaled, and BeginBlock ROLLS BACK any
+    journal left by a block that never reached Commit. This makes
+    block replay idempotent: if a node dies mid-block while its
+    external app process lives on (observed: a graceful restart
+    interrupting delivery — randomized campaign seed 131), the
+    handshake's BeginBlock for the same height undoes the
+    half-applied writes instead of double-applying them — the
+    deliverState-reset semantics production ABCI apps implement."""
+
     def __init__(self):
         self.db: DB = MemDB()
         self.size = 0
         self.height = 0
         self.app_hash = b""
+        self._undo: list[tuple[bytes, bytes | None]] = []
+        self._committed_size = 0
 
     def info(self, req: t.RequestInfo) -> t.ResponseInfo:
         return t.ResponseInfo(
@@ -44,11 +57,28 @@ class KVStoreApp(t.Application):
     def check_tx(self, req: t.RequestCheckTx) -> t.ResponseCheckTx:
         return t.ResponseCheckTx(code=t.CODE_TYPE_OK, gas_wanted=1)
 
+    def _rollback_partial(self) -> None:
+        if not self._undo:
+            return
+        for k, old in reversed(self._undo):
+            if old is None:
+                self.db.delete(k)
+            else:
+                self.db.set(k, old)
+        self._undo.clear()
+        self.size = self._committed_size
+
+    def begin_block(self, req: t.RequestBeginBlock) -> t.ResponseBeginBlock:
+        self._rollback_partial()
+        return t.ResponseBeginBlock()
+
     def deliver_tx(self, req: t.RequestDeliverTx) -> t.ResponseDeliverTx:
         key, sep, value = req.tx.partition(b"=")
         if not sep:
             key = value = req.tx
-        self.db.set(b"kv:" + key, value)
+        k = b"kv:" + key
+        self._undo.append((k, self.db.get(k)))
+        self.db.set(k, value)
         self.size += 1
         return t.ResponseDeliverTx(
             code=t.CODE_TYPE_OK,
@@ -61,7 +91,16 @@ class KVStoreApp(t.Application):
             }],
         )
 
+    def _mark_committed(self) -> None:
+        """Seal the journal: current state is now the rollback point.
+        Called at Commit AND after a statesync restore (a stale
+        journal replayed into freshly restored state would corrupt
+        it)."""
+        self._undo.clear()
+        self._committed_size = self.size
+
     def commit(self, req: t.RequestCommit) -> t.ResponseCommit:
+        self._mark_committed()
         self.app_hash = struct.pack(">Q", self.size)
         self.height += 1
         return t.ResponseCommit(data=self.app_hash)
@@ -86,6 +125,7 @@ class PersistentKVStoreApp(KVStoreApp):
         super().__init__()
         self.db = db or MemDB()
         self.val_updates: list[t.ValidatorUpdate] = []
+        self._undo_vals: list[tuple[str, int | None]] = []
         self.validators: dict[str, int] = {}  # pubkey hex -> power
         self.retain_blocks = 0
         # taken every snapshot_interval heights, last keep_snapshots
@@ -100,6 +140,7 @@ class PersistentKVStoreApp(KVStoreApp):
             self.height = d["height"]
             self.app_hash = bytes.fromhex(d["app_hash"])
             self.validators = d.get("validators", {})
+            self._mark_committed()
 
     def init_chain(self, req: t.RequestInitChain) -> t.ResponseInitChain:
         for vu in req.validators:
@@ -107,6 +148,13 @@ class PersistentKVStoreApp(KVStoreApp):
         return t.ResponseInitChain()
 
     def begin_block(self, req: t.RequestBeginBlock) -> t.ResponseBeginBlock:
+        super().begin_block(req)  # roll back any half-applied kv block
+        for hx, old in reversed(self._undo_vals):
+            if old is None:
+                self.validators.pop(hx, None)
+            else:
+                self.validators[hx] = old
+        self._undo_vals.clear()
         self.val_updates = []
         return t.ResponseBeginBlock()
 
@@ -128,6 +176,10 @@ class PersistentKVStoreApp(KVStoreApp):
                 code=1, log=f"invalid validator tx {tx!r}"
             )
         vu = t.ValidatorUpdate("ed25519", pub_key, power)
+        # journaled like the kv writes: a replayed half-block rolls
+        # the set back before re-applying
+        self._undo_vals.append(
+            (pub_key.hex(), self.validators.get(pub_key.hex())))
         self._update_validator(vu)
         self.val_updates.append(vu)
         return t.ResponseDeliverTx(code=t.CODE_TYPE_OK)
@@ -145,7 +197,12 @@ class PersistentKVStoreApp(KVStoreApp):
     def _compute_app_hash(self) -> bytes:
         return struct.pack(">Q", self.size)
 
+    def _mark_committed(self) -> None:
+        super()._mark_committed()
+        self._undo_vals.clear()
+
     def commit(self, req: t.RequestCommit) -> t.ResponseCommit:
+        self._mark_committed()
         self.app_hash = self._compute_app_hash()
         self.height += 1
         self.db.set(_STATE_KEY, json.dumps({
@@ -237,6 +294,10 @@ class PersistentKVStoreApp(KVStoreApp):
         self.height = d["height"]
         self.app_hash = bytes.fromhex(d["app_hash"])
         self.validators = d["validators"]
+        # restored state is the new rollback point; a stale journal
+        # from a block interrupted before the restore must never
+        # replay into it
+        self._mark_committed()
         ops.append((_STATE_KEY, json.dumps({
             "size": self.size, "height": self.height,
             "app_hash": self.app_hash.hex(), "validators": self.validators,

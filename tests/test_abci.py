@@ -201,3 +201,89 @@ def test_app_conns_share_one_app():
         await conns.stop()
 
     run(go())
+
+
+def test_half_delivered_block_replay_is_idempotent():
+    """A node dying mid-block leaves the (external, still-running) app
+    with half-delivered txs; the handshake then replays the SAME block
+    from BeginBlock. The staged-overlay design must discard the
+    partial writes instead of double-applying (found by randomized
+    campaign seed 131: restarted node diverged with wrong AppHash —
+    app hash counted a tx twice)."""
+    import struct
+
+    from tendermint_tpu.abci import types as t
+    from tendermint_tpu.abci.kvstore import (
+        PersistentKVStoreApp, encode_validator_tx,
+    )
+
+    app = PersistentKVStoreApp()
+    # block 1, fully committed
+    app.begin_block(t.RequestBeginBlock())
+    app.deliver_tx(t.RequestDeliverTx(b"a=1"))
+    app.deliver_tx(t.RequestDeliverTx(b"b=2"))
+    app.end_block(t.RequestEndBlock(1))
+    app.commit(t.RequestCommit())
+    assert app.size == 2 and app.height == 1
+
+    # block 2: half-delivered (kv tx + validator tx), then the node
+    # dies — no EndBlock/Commit
+    app.begin_block(t.RequestBeginBlock())
+    app.deliver_tx(t.RequestDeliverTx(b"c=3"))
+    app.deliver_tx(t.RequestDeliverTx(
+        encode_validator_tx("11" * 32, 5)))
+    # writes are LIVE mid-block (reference kvstore behavior, goldens
+    # depend on it) but journaled
+    assert app.size == 3 and app.db.get(b"kv:c") == b"3"
+    assert app.validators["11" * 32] == 5
+
+    # restarted node's handshake replays block 2 from scratch —
+    # BeginBlock must first roll the half-applied writes back
+    app.begin_block(t.RequestBeginBlock())
+    app.deliver_tx(t.RequestDeliverTx(b"c=3"))
+    app.deliver_tx(t.RequestDeliverTx(
+        encode_validator_tx("11" * 32, 5)))
+    eb = app.end_block(t.RequestEndBlock(2))
+    res = app.commit(t.RequestCommit())
+    # exactly once: size 3 (not 4), validator present once
+    assert app.size == 3
+    assert res.data == struct.pack(">Q", 3)
+    assert app.validators["11" * 32] == 5
+    assert len(eb.validator_updates) == 1
+    assert app.db.get(b"kv:c") == b"3"
+
+
+def test_statesync_restore_clears_stale_journal():
+    """A snapshot restore on an app holding a half-delivered block's
+    journal must NOT replay that journal into the restored state
+    (review finding on the journal design)."""
+    from tendermint_tpu.abci import types as t
+    from tendermint_tpu.abci.kvstore import PersistentKVStoreApp
+
+    src = PersistentKVStoreApp(snapshot_interval=1)
+    src.begin_block(t.RequestBeginBlock())
+    src.deliver_tx(t.RequestDeliverTx(b"x=1"))
+    src.end_block(t.RequestEndBlock(1))
+    src.commit(t.RequestCommit())
+    snaps = src.list_snapshots(t.RequestListSnapshots()).snapshots
+    assert snaps
+
+    dst = PersistentKVStoreApp()
+    # dst has a half-delivered block in flight when it restores
+    dst.begin_block(t.RequestBeginBlock())
+    dst.deliver_tx(t.RequestDeliverTx(b"stale=9"))
+    snap = snaps[-1]
+    dst.offer_snapshot(t.RequestOfferSnapshot(snapshot=snap,
+                                              app_hash=src.app_hash))
+    for i in range(snap.chunks):
+        chunk = src.load_snapshot_chunk(
+            t.RequestLoadSnapshotChunk(
+                height=snap.height, format=snap.format, chunk=i)).chunk
+        dst.apply_snapshot_chunk(t.RequestApplySnapshotChunk(
+            index=i, chunk=chunk))
+    # next block begins: the stale journal must not roll anything back
+    dst.begin_block(t.RequestBeginBlock())
+    assert dst.size == src.size == 1
+    assert dst.db.get(b"kv:x") == b"1"
+    res = dst.commit(t.RequestCommit())
+    assert res.data == src.app_hash
