@@ -141,6 +141,15 @@ def worker():
 
     import jax
 
+    from tendermint_tpu.libs.tracing import TRACER
+
+    def stage_breakdown():
+        """Per-stage p50/p95/p99 rollup of the crypto spans recorded
+        since the last TRACER.clear(): device-exec vs host-pack vs
+        dispatch/readback attribution rides in every BENCH line
+        instead of a single end-to-end number."""
+        return TRACER.stage_rollup(prefix="crypto.")
+
     device = str(jax.devices()[0])
     common = {
         "metric": METRIC,
@@ -163,9 +172,11 @@ def worker():
     exp1k = ex.get_expanded(pubs[:n1k])
     idx1k = list(range(n1k))
     assert bool(exp1k.verify(idx1k, msgs[:n1k], sigs[:n1k]).all())
+    TRACER.clear()  # rollup covers the measured reps only, not warm-up
     p50_1k = _measure(
         lambda: exp1k.verify(idx1k, msgs[:n1k], sigs[:n1k]), 7, warmed=True)
     line1k = {
+        "stage_breakdown": stage_breakdown(),
         **common,
         "value": round(p50_1k * 1e3 * (n / n1k), 3),  # scaled projection
         "vs_baseline": round(cpu_per_sig * n1k / p50_1k, 2),
@@ -216,7 +227,9 @@ def worker():
     exp = ex.get_expanded(pubs)
     idx = list(range(n))
     assert bool(exp.verify(idx, msgs, sigs).all()), "bench batch must verify"
+    TRACER.clear()
     p50 = _measure(lambda: exp.verify(idx, msgs, sigs), 7, warmed=True)
+    stages = stage_breakdown()
 
     # The headline number is on record NOW — the diagnostic extras
     # below each trigger fresh XLA compiles (new shapes), i.e. fresh
@@ -229,6 +242,7 @@ def worker():
         "sigs_per_sec": round(n / p50),
         "batch": n,
         "expanded_valset": True,
+        "stage_breakdown": stages,
     }
     _emit(line)
 
@@ -305,7 +319,9 @@ def worker():
         sb = CommitSignBatch("bench-chain", commit, idxs)
         return exp.verify_structured(idxs, sb, csigs)
 
+    TRACER.clear()
     p50_s = _measure(run_structured, 7, warmed=True)
+    stages_structured = stage_breakdown()
     # The recorded headline is the BEST product path for THIS real
     # commit, compared apples-to-apples: the bytes path timed on the
     # SAME ~187-byte canonical sign bytes (stage 2's number above used
@@ -337,6 +353,7 @@ def worker():
         "synthetic_msgs_p50_ms": line["value"],
         "device_exec_ms_per_launch":
             line.get("device_exec_ms_per_launch"),
+        "stage_breakdown": stages_structured,
     }
     _emit(line_s)
 

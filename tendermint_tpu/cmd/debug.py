@@ -10,6 +10,9 @@ Both commands aggregate, into a .tar.gz archive:
   goroutine.txt         debug server /debug/pprof/goroutine
                         (asyncio-task + thread stacks)
   heap.txt              debug server /debug/pprof/heap
+  trace.json            debug server /debug/trace (span timeline,
+                        Chrome trace-event JSON for Perfetto)
+  trace_rollup.json     per-span-kind p50/p95/p99 rollup
   config.toml           the node's config file
   cs.wal/               copy of the consensus WAL directory
 
@@ -75,6 +78,8 @@ def _collect(tmp: str, rpc_addr: str, pprof_addr: str, home: str,
     for path, fname in (
         ("/debug/pprof/goroutine", "goroutine.txt"),
         ("/debug/pprof/heap", "heap.txt"),
+        ("/debug/trace", "trace.json"),
+        ("/debug/trace/rollup", "trace_rollup.json"),
     ):
         try:
             data = _pprof_get(pprof_addr, path)
@@ -143,6 +148,47 @@ def cmd_debug_kill(args) -> int:
     return 0
 
 
+def cmd_debug_trace(args) -> int:
+    """Capture the node's span-tracer ring as a Perfetto-loadable
+    Chrome trace-event JSON file (plus the per-stage rollup on
+    stdout). The lightweight sibling of kill/dump for the question
+    'where did the last N seconds actually go'."""
+    try:
+        raw = _pprof_get(
+            args.pprof_laddr,
+            f"/debug/trace?seconds={args.seconds}"
+            if args.seconds else "/debug/trace")
+        trace = json.loads(raw)
+        events = trace.get("traceEvents")
+        if not isinstance(events, list):
+            raise ValueError("response is not Chrome trace-event JSON")
+    except Exception as e:
+        print(f"error: trace capture failed: {e!r}")
+        return 1
+    try:
+        out_dir = os.path.dirname(os.path.abspath(args.output_file))
+        os.makedirs(out_dir, exist_ok=True)
+        with open(args.output_file, "wb") as f:
+            f.write(raw)
+    except OSError as e:
+        print(f"error: cannot write {args.output_file}: {e!r}")
+        return 1
+    print(f"wrote {len(events)} spans: {args.output_file} "
+          "(open in https://ui.perfetto.dev or chrome://tracing)")
+    try:
+        rollup = json.loads(_pprof_get(
+            args.pprof_laddr,
+            f"/debug/trace/rollup?seconds={args.seconds}"
+            if args.seconds else "/debug/trace/rollup"))
+        for kind, row in rollup.items():
+            print(f"  {kind:<24} n={row['count']:<6} "
+                  f"p50={row['p50_ms']}ms p95={row['p95_ms']}ms "
+                  f"p99={row['p99_ms']}ms")
+    except Exception as e:
+        print(f"warning: rollup unavailable: {e!r}")
+    return 0
+
+
 def cmd_debug_dump(args) -> int:
     """reference: cmd/tendermint/commands/debug/dump.go — poll forever
     (or --count times), one timestamped bundle per interval."""
@@ -195,6 +241,16 @@ def register(sub) -> None:
     for flag, kw in common.items():
         kp.add_argument(flag, **kw)
     kp.set_defaults(fn=cmd_debug_kill)
+
+    tp = dsub.add_parser(
+        "trace", help="capture a span trace (Perfetto/Chrome JSON)")
+    tp.add_argument("output_file", help="output trace.json path")
+    tp.add_argument("--seconds", type=float, default=0.0,
+                    help="window to the trailing N seconds "
+                         "(default: the whole span ring)")
+    for flag, kw in common.items():
+        tp.add_argument(flag, **kw)
+    tp.set_defaults(fn=cmd_debug_trace)
 
     dp = dsub.add_parser(
         "dump", help="periodically capture debug bundles")

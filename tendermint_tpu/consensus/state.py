@@ -20,6 +20,7 @@ import time as _time
 from dataclasses import dataclass
 
 from ..config import ConsensusConfig
+from ..libs import tracing
 from ..libs.fail import fail
 from ..libs.service import Service
 from ..mempool import Mempool, NopMempool
@@ -49,6 +50,12 @@ class _QueuedMsg:
 
 
 class ConsensusState(Service):
+    # Span handles for the per-height trace timeline. Class-level
+    # defaults because update_to_state (which rolls them) runs inside
+    # __init__ before any instance attribute could be assigned.
+    _ht_span = None
+    _step_span = None
+
     def __init__(self, config: ConsensusConfig, state: SmState,
                  block_exec: BlockExecutor, block_store: BlockStore,
                  mempool: Mempool | None = None, evpool=None,
@@ -170,6 +177,25 @@ class ConsensusState(Service):
             valid_round=-1,
         )
         self.state = state
+        self._trace_new_height(height)
+
+    def _trace_new_height(self, height: int) -> None:
+        """Roll the per-height trace timeline: seal the previous
+        height's step + root spans, open the next root. Manually
+        managed (not a with-block) because a height's lifetime spans
+        many handler invocations across two tasks (receive routine and
+        vote scheduler)."""
+        t = tracing.TRACER
+        if self._step_span is not None:
+            self._step_span.end()
+            self._step_span = None
+        if self._ht_span is not None:
+            self._ht_span.end()
+        # parent=NOOP_SPAN pins the root parentless: update_to_state
+        # can run inside the vote scheduler's active vote_batch span,
+        # and a height must never parent under a vote batch.
+        self._ht_span = t.begin(tracing.CONSENSUS_HEIGHT,
+                                parent=tracing.NOOP_SPAN, height=height)
 
     def reconstruct_last_commit(self) -> None:
         """Rebuild rs.last_commit from the stored seen commit
@@ -339,6 +365,11 @@ class ConsensusState(Service):
 
     def _new_step(self, step: RoundStep) -> None:
         self.rs.step = step
+        if self._step_span is not None:
+            self._step_span.end()
+        self._step_span = tracing.TRACER.begin(
+            tracing.consensus_step_kind(step.name), parent=self._ht_span,
+            height=self.rs.height, round=self.rs.round)
         rsm = RoundStateMessage(self.rs.height, self.rs.round, int(step))
         self._wal_write(rsm)
         if self.event_bus is not None:
@@ -650,6 +681,17 @@ class ConsensusState(Service):
 
         block.validate_basic()
 
+        # Explicit trace handoff: finalize can run from the receive
+        # routine OR the vote scheduler task, so the commit step span
+        # is attached by handle (not ambient context) — wal.fsync and
+        # state.apply_block below then nest under it either way.
+        with tracing.TRACER.attach(self._step_span):
+            await self._finalize_commit_traced(height, bid, block, parts,
+                                               precommits)
+
+    async def _finalize_commit_traced(self, height, bid, block, parts,
+                                      precommits) -> None:
+        rs = self.rs
         if self.block_store.height < block.header.height:
             seen_commit = precommits.make_commit()
             self.block_store.save_block(block, parts, seen_commit)
@@ -982,14 +1024,19 @@ class ConsensusState(Service):
     async def _verify_and_commit_batch(self, batch, met, loop) -> None:
         met.vote_batch_size.observe(len(batch))
         chain_id = self.state.chain_id
-        if len(batch) > 1:
-            # Device (or host-oracle) verify OFF the event loop:
-            # gossip, RPC and timeouts keep running during a
-            # 10k-lane burst.
-            verdicts = await loop.run_in_executor(
-                None, self._batch_verdicts, batch, chain_id)
-        else:
-            verdicts = self._batch_verdicts(batch, chain_id)
+        with tracing.TRACER.span(tracing.CONSENSUS_VOTE_BATCH,
+                                 lanes=len(batch)):
+            if len(batch) > 1:
+                # Device (or host-oracle) verify OFF the event loop:
+                # gossip, RPC and timeouts keep running during a
+                # 10k-lane burst. TRACER.wrap carries the vote-batch
+                # span into the executor thread so the crypto spans
+                # recorded there keep their consensus lineage.
+                verdicts = await loop.run_in_executor(
+                    None, tracing.TRACER.wrap(self._batch_verdicts),
+                    batch, chain_id)
+            else:
+                verdicts = self._batch_verdicts(batch, chain_id)
         per_peer: dict[str, list[int]] = {}  # peer -> [good, bad]
         for (vote, peer_id, _, _), ok in zip(batch, verdicts):
             if peer_id:

@@ -18,6 +18,7 @@ from dataclasses import dataclass
 logger = logging.getLogger("wal")
 
 from ..encoding.proto import Reader, Writer
+from ..libs import tracing
 
 MAX_MSG_SIZE = 1 << 20  # 1MB, reference wal.go maxMsgSizeBytes
 
@@ -249,7 +250,8 @@ class WAL:
 
     def write_sync(self, msg: object, time_ns: int = 0) -> None:
         self.write(msg, time_ns)
-        self.flush_and_sync()
+        with tracing.TRACER.span(tracing.WAL_FSYNC):
+            self.flush_and_sync()
 
     def flush_and_sync(self) -> None:
         self._f.flush()
@@ -265,14 +267,24 @@ class WAL:
     # -- reading --
 
     @staticmethod
+    def _read_bytes(path: str) -> bytes:
+        """One atomic read of a segment's current contents. Decoding
+        and size accounting below both work off THIS byte string —
+        never a re-stat of the live file (see _decode_file)."""
+        if not os.path.exists(path):
+            return b""
+        with open(path, "rb") as f:
+            return f.read()
+
+    @staticmethod
     def _iter_records(path: str, strict: bool = False):
         """Yield (TimedWALMessage, consumed_bytes_after) one record at
         a time. On a corrupt/torn record, stop (strict=False — crash
         tails are expected) or raise (strict=True)."""
-        if not os.path.exists(path):
-            return
-        with open(path, "rb") as f:
-            data = f.read()
+        yield from WAL._iter_data(WAL._read_bytes(path), strict)
+
+    @staticmethod
+    def _iter_data(data: bytes, strict: bool = False):
         pos = 0
         while pos + _FRAME.size <= len(data):
             crc, ln = _FRAME.unpack_from(data, pos)
@@ -299,13 +311,18 @@ class WAL:
                      strict: bool = False
                      ) -> tuple[list[TimedWALMessage], int, int]:
         """Every record of one file; returns (messages,
-        consumed_bytes, file_size)."""
+        consumed_bytes, bytes_read).
+
+        The size reported is len() of the bytes actually decoded, NOT
+        a fresh stat: a record appended between the read and a re-stat
+        would make size > consumed and repair() would truncate the
+        perfectly valid new record off a healthy WAL."""
+        data = WAL._read_bytes(path)
         out: list[TimedWALMessage] = []
         pos = 0
-        for msg, pos in WAL._iter_records(path, strict):
+        for msg, pos in WAL._iter_data(data, strict):
             out.append(msg)
-        size = os.path.getsize(path) if os.path.exists(path) else 0
-        return out, pos, size
+        return out, pos, len(data)
 
     @staticmethod
     def decode_all(path: str, strict: bool = False) -> list[TimedWALMessage]:

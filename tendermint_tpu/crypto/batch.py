@@ -23,6 +23,7 @@ import time
 import numpy as np
 
 from . import PubKey
+from ..libs import tracing
 
 logger = logging.getLogger("crypto.batch")
 
@@ -93,7 +94,8 @@ class BatchVerifier:
         if n == 0:
             return True, np.zeros(0, bool)
         verdicts = np.zeros(n, bool)
-        with m.batch_seconds.time():
+        with m.batch_seconds.time(), \
+                tracing.TRACER.span(tracing.CRYPTO_BATCH, lanes=n):
             # Group lanes by key type; each goes through its backend.
             by_type: dict[str, list[int]] = {}
             for i, (pk, _, _) in enumerate(self._items):
@@ -136,14 +138,16 @@ class BatchVerifier:
             met.batch_lanes.inc(len(items), backend="host")
             # Host path: the per-key OpenSSL fast path (strict-accept ->
             # accept; reject -> ZIP-215 oracle recheck, crypto/ed25519.py).
-            return np.fromiter(
-                (
-                    len(s) == 64 and pk.verify_signature(m, s)
-                    for pk, m, s in items
-                ),
-                bool,
-                count=len(items),
-            )
+            with tracing.TRACER.span(tracing.CRYPTO_HOST_VERIFY,
+                                     lanes=len(items), backend="host"):
+                return np.fromiter(
+                    (
+                        len(s) == 64 and pk.verify_signature(m, s)
+                        for pk, m, s in items
+                    ),
+                    bool,
+                    count=len(items),
+                )
         if type_name == "sr25519":
             use_dev = self._use_device
             if use_dev is None:
@@ -195,8 +199,11 @@ class BatchVerifier:
         met.batch_lanes.inc(len(items), backend=f"host-{type_name}")
         # Remaining key types (secp256k1; small sr25519 groups):
         # host-side one-by-one via the PubKey objects we already hold.
-        return np.fromiter(
-            (pk.verify_signature(m, s) for pk, m, s in items),
-            bool,
-            count=len(items),
-        )
+        with tracing.TRACER.span(tracing.CRYPTO_HOST_VERIFY,
+                                 lanes=len(items),
+                                 backend=f"host-{type_name}"):
+            return np.fromiter(
+                (pk.verify_signature(m, s) for pk, m, s in items),
+                bool,
+                count=len(items),
+            )

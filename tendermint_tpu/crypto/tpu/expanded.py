@@ -47,6 +47,7 @@ from collections import OrderedDict
 import numpy as np
 
 from . import verify as tv
+from ...libs import tracing
 
 _WINDOWS = 69  # scalar.DIGITS_K: folded challenge < 2^271
 _ENTRIES = 9   # signed digits: |d| in 0..8
@@ -452,9 +453,32 @@ class ExpandedKeys:
         n = len(indices)
         if n == 0:
             return np.zeros(0, bool)
-        idx, packed, well_formed = self._prepare(indices, msgs, sigs)
-        out = self._launch(idx, packed)
-        return np.asarray(out)[:n] & well_formed
+
+        def prepare():
+            idx, packed, well_formed = self._prepare(indices, msgs, sigs)
+            return (idx, packed), well_formed
+
+        return self._traced_verify(n, "expanded", prepare, self._launch)
+
+    def _traced_verify(self, n, backend, prepare, launch) -> np.ndarray:
+        """Shared span choreography for both verify forms: one
+        crypto.verify parent with pack (host prep) / dispatch (launch
+        enqueue) / device_exec (wait-until-ready) / readback (D2H
+        copy) children — the stage taxonomy BENCH's stage_breakdown
+        and /debug/trace report. `prepare` returns (launch_args,
+        well_formed); `launch(*launch_args)` returns the device
+        verdict array."""
+        t = tracing.TRACER
+        with t.span(tracing.CRYPTO_VERIFY, lanes=n, backend=backend):
+            with t.span(tracing.CRYPTO_PACK, lanes=n):
+                launch_args, well_formed = prepare()
+            with t.span(tracing.CRYPTO_DISPATCH, lanes=n):
+                out = launch(*launch_args)
+            if hasattr(out, "block_until_ready"):
+                with t.span(tracing.CRYPTO_DEVICE_EXEC, lanes=n):
+                    out.block_until_ready()
+            with t.span(tracing.CRYPTO_READBACK, lanes=n):
+                return np.asarray(out)[:n] & well_formed
 
     # -- structured commit path (message bytes assembled on device) --
 
@@ -541,10 +565,14 @@ class ExpandedKeys:
         n = len(indices)
         if n == 0:
             return np.zeros(0, bool)
-        idx, fields, well_formed, width = self._prepare_structured(
-            indices, sbatch, sigs)
-        out = self._launch_structured(idx, fields, width)
-        return np.asarray(out)[:n] & well_formed
+
+        def prepare():
+            idx, fields, well_formed, width = self._prepare_structured(
+                indices, sbatch, sigs)
+            return (idx, fields, width), well_formed
+
+        return self._traced_verify(n, "structured", prepare,
+                                   self._launch_structured)
 
 
 # -- process-wide LRU of expanded sets (one active + one in transition) --

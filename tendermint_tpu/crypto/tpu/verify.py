@@ -32,6 +32,7 @@ import hashlib
 import numpy as np
 
 from .. import ed25519_ref as ref
+from ...libs import tracing
 
 # Warm the native packer at import (node/verifier startup): the
 # build-on-first-use cc subprocess must never run lazily inside a
@@ -322,15 +323,24 @@ def verify_batch(pubs, msgs, sigs) -> np.ndarray:
     out = np.empty(n, bool)
     start = 0
     pending = []
-    for size in _chunks(n):
-        end = min(start + size, n)
-        pending.append(
-            (start, end, _launch_chunk(pubs[start:end], msgs[start:end],
-                                       sigs[start:end], size))
-        )
-        start = end
-    for s, e, fut in pending:
-        out[s:e] = np.asarray(fut)[: e - s]
+    t = tracing.TRACER
+    with t.span(tracing.CRYPTO_VERIFY, lanes=n, backend="general"):
+        for size in _chunks(n):
+            end = min(start + size, n)
+            pending.append(
+                (start, end, _launch_chunk(pubs[start:end], msgs[start:end],
+                                           sigs[start:end], size))
+            )
+            start = end
+        for s, e, fut in pending:
+            # device_exec = wait for the async launch's verdicts to be
+            # ready on device; readback = the D2H verdict copy. The
+            # split is what lets BENCH tell chip time from wire/host.
+            if hasattr(fut, "block_until_ready"):
+                with t.span(tracing.CRYPTO_DEVICE_EXEC, lanes=e - s):
+                    fut.block_until_ready()
+            with t.span(tracing.CRYPTO_READBACK, lanes=e - s):
+                out[s:e] = np.asarray(fut)[: e - s]
     return out & well_formed
 
 
@@ -339,23 +349,26 @@ def _launch_chunk(pubs, msgs, sigs, bucket: int):
     (async — caller materializes). Padding lanes use a fixed valid
     triple so they cannot affect real lanes."""
     n = len(pubs)
-    if bucket > n:
-        dp, dm, ds = _dummy_triple()
-        pad = bucket - n
-        pubs = list(pubs) + [dp] * pad
-        msgs = list(msgs) + [dm] * pad
-        sigs = list(sigs) + [ds] * pad
-    packed = pack_batch(pubs, msgs, sigs)
-    btab = b_comb_tables()
-    mesh = _mesh()
-    if (mesh is not None and bucket >= _SHARD_MIN
-            and bucket % mesh.devices.size == 0):
-        import jax
+    t = tracing.TRACER
+    with t.span(tracing.CRYPTO_PACK, lanes=bucket):
+        if bucket > n:
+            dp, dm, ds = _dummy_triple()
+            pad = bucket - n
+            pubs = list(pubs) + [dp] * pad
+            msgs = list(msgs) + [dm] * pad
+            sigs = list(sigs) + [ds] * pad
+        packed = pack_batch(pubs, msgs, sigs)
+    with t.span(tracing.CRYPTO_DISPATCH, lanes=bucket):
+        btab = b_comb_tables()
+        mesh = _mesh()
+        if (mesh is not None and bucket >= _SHARD_MIN
+                and bucket % mesh.devices.size == 0):
+            import jax
 
-        row_s, vec_s, repl_s = _shardings(mesh)
-        packed = {
-            k: jax.device_put(v, vec_s if v.ndim == 1 else row_s)
-            for k, v in packed.items()
-        }
-        btab = jax.device_put(btab, repl_s)
-    return _kernel()(btab=btab, **packed)
+            row_s, vec_s, repl_s = _shardings(mesh)
+            packed = {
+                k: jax.device_put(v, vec_s if v.ndim == 1 else row_s)
+                for k, v in packed.items()
+            }
+            btab = jax.device_put(btab, repl_s)
+        return _kernel()(btab=btab, **packed)

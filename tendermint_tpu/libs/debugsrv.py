@@ -14,6 +14,12 @@ Reference: node/node.go:807-812 serves net/http/pprof on
   GET /debug/pprof/profile?seconds=N
                                cProfile the event loop process for N
                                seconds, return pstats text
+  GET /debug/trace?seconds=N   span-tracer ring (libs/tracing.py) as
+                               Chrome trace-event JSON — load in
+                               Perfetto / chrome://tracing; seconds
+                               windows to the trailing N s (default:
+                               the whole ring)
+  GET /debug/trace/rollup      per-span-kind p50/p95/p99 rollup JSON
   GET /metrics                 Prometheus text exposition
 
 Used by `tendermint-tpu debug kill|dump` (cmd/) to capture diagnostics
@@ -142,9 +148,12 @@ class DebugServer:
                 kv.partition("=")[::2] for kv in query.split("&") if kv
             )
             body = await self._route(path, params)
+            ctype = b"text/plain"
+            if isinstance(body, tuple):
+                body, ctype = body
             writer.write(
-                b"HTTP/1.0 200 OK\r\nContent-Type: text/plain\r\n"
-                b"Content-Length: " + str(len(body)).encode() +
+                b"HTTP/1.0 200 OK\r\nContent-Type: " + ctype +
+                b"\r\nContent-Length: " + str(len(body)).encode() +
                 b"\r\n\r\n" + body
             )
             await writer.drain()
@@ -159,7 +168,8 @@ class DebugServer:
     async def _route(self, path: str, params: dict) -> bytes:
         if path in ("/debug/pprof", "/debug/pprof/"):
             return (b"pprof endpoints: goroutine, heap?seconds=N, "
-                    b"profile?seconds=N; also /metrics\n")
+                    b"profile?seconds=N; also /metrics, "
+                    b"/debug/trace?seconds=N, /debug/trace/rollup\n")
         if path == "/debug/pprof/goroutine":
             return _goroutine_dump().encode()
         if path == "/debug/pprof/heap":
@@ -168,6 +178,30 @@ class DebugServer:
         if path == "/debug/pprof/profile":
             secs = _parse_seconds(params.get("seconds"), 5.0, cap=60.0)
             return (await _profile(secs)).encode()
+        if path == "/debug/trace":
+            import json
+
+            from .tracing import TRACER, chrome_trace
+
+            secs = _parse_seconds(params.get("seconds"), 0.0, cap=3600.0)
+            # snapshot() is a cheap ring copy, but rendering 16k+
+            # spans to JSON is tens of ms (more with a resized ring)
+            # — do it off the event loop so a trace capture (or a
+            # polling `debug dump`) never stalls consensus/gossip.
+            recs = TRACER.snapshot(seconds=secs or None)
+            body = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: json.dumps(chrome_trace(recs)).encode())
+            return body, b"application/json"
+        if path == "/debug/trace/rollup":
+            import json
+
+            from .tracing import TRACER
+
+            secs = _parse_seconds(params.get("seconds"), 0.0, cap=3600.0)
+            body = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: json.dumps(
+                    TRACER.stage_rollup(seconds=secs or None)).encode())
+            return body, b"application/json"
         if path == "/metrics":
             from .metrics import DEFAULT
 

@@ -15,6 +15,7 @@ import asyncio
 import time
 from dataclasses import dataclass, field
 
+from ...libs import tracing
 from ...libs.service import Service
 from .secret_connection import DATA_MAX, SEALED_SIZE, SecretConnection
 
@@ -192,7 +193,8 @@ class MConnection(Service):
                 ch = self._pick_channel()
                 if ch is None:
                     # flush whatever is buffered before going idle
-                    await self.conn.drain()
+                    with tracing.TRACER.span(tracing.P2P_SEND_FLUSH):
+                        await self.conn.drain()
                     self._send_signal.clear()
                     # decay recently_sent while idle (reference: 2x/s)
                     for c in self.channels.values():
@@ -211,7 +213,8 @@ class MConnection(Service):
                 # plus once when the queues run dry above.
                 now = time.monotonic()
                 if now - last_flush >= throttle:
-                    await self.conn.drain()
+                    with tracing.TRACER.span(tracing.P2P_SEND_FLUSH):
+                        await self.conn.drain()
                     last_flush = now
         except asyncio.CancelledError:
             raise
@@ -248,9 +251,14 @@ class MConnection(Service):
                     if eof:
                         msg = bytes(ch.recv_buf)
                         ch.recv_buf = bytearray()
-                        res = self.on_receive(chan_id, msg)
-                        if asyncio.iscoroutine(res):
-                            await res
+                        # one span per COMPLETE message (per-packet
+                        # spans would dominate the ring under load)
+                        with tracing.TRACER.span(tracing.P2P_RECV_MSG,
+                                                 chan=chan_id,
+                                                 nbytes=len(msg)):
+                            res = self.on_receive(chan_id, msg)
+                            if asyncio.iscoroutine(res):
+                                await res
                 else:
                     raise ValueError(f"unknown packet type {t}")
         except asyncio.CancelledError:

@@ -58,7 +58,10 @@ class PEXReactor(Reactor):
         self.request_send_spacing = \
             _REQUEST_SPACING_FACTOR * ensure_period
         self._last_request_from: dict[str, float] = {}
-        self._flood_strikes: dict[str, int] = {}
+        # peer.id -> monotonic timestamps of over-rate requests still
+        # inside the current bar (strikes older than request_interval
+        # expire — see receive())
+        self._flood_strikes: dict[str, list[float]] = {}
         self._requested: set[str] = set()
         # NOT cleared on remove_peer: rate limit outlives reconnects
         self._last_request_to: dict[str, float] = {}
@@ -125,12 +128,26 @@ class PEXReactor(Reactor):
             now = time.monotonic()
             last = self._last_request_from.get(peer.id, 0.0)
             if now - last < self.request_interval and not self.seed_mode:
-                strikes = self._flood_strikes.get(peer.id, 0) + 1
+                # Timestamped strikes, expiring after one bar
+                # (request_interval) — matching the comment above:
+                # flood = _FLOOD_STRIKES over-rate requests INSIDE ONE
+                # BAR. The old integer counter reset on every accepted
+                # request and never decayed otherwise, so a peer
+                # pacing just under the bar could sustain a multiple
+                # of the intended request rate forever by sneaking an
+                # accepted request between strikes; conversely a
+                # counter that never expired would eventually flag an
+                # innocent config-skewed peer. Age-based expiry gives
+                # both properties.
+                strikes = [
+                    t for t in self._flood_strikes.get(peer.id, ())
+                    if now - t < self.request_interval
+                ]
+                strikes.append(now)
                 self._flood_strikes[peer.id] = strikes
-                if strikes >= _FLOOD_STRIKES:
+                if len(strikes) >= _FLOOD_STRIKES:
                     raise ValueError("pex request flood")
                 return  # mildly early (config skew): ignore, no answer
-            self._flood_strikes.pop(peer.id, None)
             self._last_request_from[peer.id] = now
             sel = self.book.get_selection()
             await peer.send(PEX_CHANNEL, json.dumps(

@@ -125,19 +125,25 @@ class BlockExecutor:
     async def validate_block_async(self, state: State, block: Block) -> None:
         """validate_block in a worker thread: the LastCommit signature
         batch runs on device without freezing the event loop (gossip,
-        RPC and timeouts stay live during a mega-commit verify)."""
+        RPC and timeouts stay live during a mega-commit verify).
+        TRACER.wrap carries the caller's active span into the worker
+        thread so the commit-verify crypto spans keep their lineage."""
         import asyncio
 
+        from ..libs.tracing import TRACER
+
         await asyncio.get_running_loop().run_in_executor(
-            None, validate_block, state, block, self.evpool
+            None, TRACER.wrap(validate_block), state, block, self.evpool
         )
 
     async def apply_block(self, state: State, block_id: BlockID,
                           block: Block) -> tuple[State, int]:
         """Returns (new_state, retain_height). Raises on invalid block."""
         from ..libs.metrics import state_metrics
+        from ..libs.tracing import STATE_APPLY_BLOCK, TRACER
 
-        with state_metrics().block_processing_seconds.time():
+        with state_metrics().block_processing_seconds.time(), \
+                TRACER.span(STATE_APPLY_BLOCK, height=block.header.height):
             return await self._apply_block(state, block_id, block)
 
     async def _apply_block(self, state: State, block_id: BlockID,

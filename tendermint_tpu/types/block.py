@@ -51,6 +51,16 @@ class BlockID:
     def is_nil(self) -> bool:
         return not self.hash
 
+    def is_zero(self) -> bool:
+        """Reference BlockID.IsZero (types/block.go): empty hash AND
+        zero part_set_header. This — not is_nil()'s hash-only check —
+        is what gates canonical/proto omission: a BlockID carrying a
+        part-set header with an empty hash must still encode, or its
+        sign bytes diverge from the reference's."""
+        return not self.hash and (
+            self.part_set_header is None or self.part_set_header.is_zero()
+        )
+
     def is_complete(self) -> bool:
         return (
             len(self.hash) == tmhash.SIZE
@@ -89,7 +99,15 @@ def block_id_writer(bid: BlockID | None) -> Writer | None:
     the reference (types.proto:98-99), so whenever a BlockID message is
     marshaled at all, field 2 is present — even as an empty submessage.
     Cross-validated against the reference MBT corpus header hashes
-    (light/mbt_ref.py)."""
+    (light/mbt_ref.py).
+
+    Only the repo's None-psh nil sentinel omits here: an EXPLICIT zero
+    part_set_header (what decoding reference-marshaled nil-vote bytes
+    produces) still emits `field {psh: {}}` byte-identically with the
+    gogo marshaler. Full IsZero() omission applies to CANONICAL sign
+    bytes only (canonical.canonical_block_id_writer), where the
+    reference's CanonicalizeBlockID nils out zero ids — this writer's
+    behavior is deliberately UNCHANGED by that fix."""
     if bid is None or (bid.is_nil() and bid.part_set_header is None):
         return None
     w = Writer()

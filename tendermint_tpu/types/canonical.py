@@ -33,11 +33,15 @@ def timestamp_writer(time_ns: int) -> Writer | None:
 
 def canonical_block_id_writer(block_id) -> Writer | None:
     """block_id: types.block.BlockID or None. CanonicalizeBlockID
-    returns nil for a zero block id (field omitted — nil votes), but a
-    present CanonicalBlockID always carries its part_set_header: the
-    field is gogoproto nullable=false (canonical.proto:12), so the
-    reference emits it even when empty."""
-    if block_id is None or block_id.is_nil():
+    returns nil for a ZERO block id (field omitted — nil votes), where
+    zero is the reference's IsZero: empty hash AND zero
+    part_set_header — NOT is_nil()'s hash-only check (an empty-hash
+    BlockID with a real part-set header still canonicalizes, keeping
+    sign bytes byte-identical with the reference). A present
+    CanonicalBlockID always carries its part_set_header: the field is
+    gogoproto nullable=false (canonical.proto:12), so the reference
+    emits it even when empty."""
+    if block_id is None or block_id.is_zero():
         return None
     w = Writer()
     w.bytes(1, block_id.hash)

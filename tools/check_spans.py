@@ -1,0 +1,134 @@
+"""Span-tracing lint + overhead budget (invoked from the test suite).
+
+Two checks keep the tracer honest as instrumentation spreads:
+
+1. No ad-hoc span strings. Every `TRACER.span(...)` / `TRACER.begin(...)`
+   call site in tendermint_tpu/ must name a registered constant from
+   libs/tracing.py, never a string literal — the registry is what makes
+   `/debug/trace` rollups and the BENCH stage_breakdown enumerable, and
+   a typo'd literal would otherwise mint a new timeline row silently.
+   (The tracer also rejects unregistered kinds at runtime; this lint
+   catches the literal-at-call-site pattern statically so the failure
+   is a test run, not a production span.)
+
+2. Overhead stays bounded. Tracing is ALWAYS ON in production, so the
+   per-span cost is a hard budget, not a vibe: a microbench times
+   enter/exit of an attribute-carrying span with the tracer enabled and
+   disabled and asserts both against fixed per-span ceilings. The
+   ceilings are deliberately loose (single-core CI box, GC noise) —
+   they exist to catch an accidental O(ring) scan or allocation storm
+   in the span path, not to benchmark it.
+
+Run directly (`python tools/check_spans.py`) for a report + exit code,
+or via tests/test_tracing.py which calls the same functions.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "tendermint_tpu")
+
+# Per-span ceilings (seconds). Measured reality on the CI box is
+# ~2-4 us enabled / ~0.5 us disabled; the budgets leave ~10x headroom
+# so only a real regression (per-span allocation storm, O(ring) work)
+# trips them.
+ENABLED_BUDGET_S = 50e-6
+DISABLED_BUDGET_S = 10e-6
+
+_SPAN_METHODS = {"span", "begin"}
+
+
+def find_ad_hoc_spans(root: str = PKG) -> list[str]:
+    """Call sites passing a string LITERAL as the span kind. Returns
+    ["path:line: message", ...]; empty means clean. libs/tracing.py
+    itself is exempt — register_kind() literals are the registry."""
+    problems = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, REPO)
+            if rel.replace(os.sep, "/") == "tendermint_tpu/libs/tracing.py":
+                continue
+            with open(path, "rb") as f:
+                try:
+                    tree = ast.parse(f.read(), filename=rel)
+                except SyntaxError as e:  # pragma: no cover
+                    problems.append(f"{rel}: unparseable: {e}")
+                    continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fobj = node.func
+                if not (isinstance(fobj, ast.Attribute)
+                        and fobj.attr in _SPAN_METHODS):
+                    continue
+                if not node.args:
+                    continue
+                first = node.args[0]
+                if isinstance(first, ast.Constant) and \
+                        isinstance(first.value, str):
+                    problems.append(
+                        f"{rel}:{node.lineno}: ad-hoc span kind "
+                        f"{first.value!r} — use a registered constant "
+                        "from libs.tracing")
+                elif isinstance(first, ast.JoinedStr):
+                    problems.append(
+                        f"{rel}:{node.lineno}: f-string span kind — "
+                        "kinds are a closed registry, not a format "
+                        "namespace")
+    return problems
+
+
+def measure_overhead(n: int = 20000) -> tuple[float, float]:
+    """(enabled_s_per_span, disabled_s_per_span) for an enter/exit of
+    an attribute-carrying span on a private tracer. Best-of-3 batches:
+    the budget polices the span path, not the box's scheduler."""
+    from tendermint_tpu.libs import tracing
+
+    kind = tracing.CRYPTO_PACK  # a real registered hot-path kind
+
+    def run(tracer: tracing.Tracer) -> float:
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for i in range(n):
+                with tracer.span(kind, lanes=i):
+                    pass
+            best = min(best, (time.perf_counter() - t0) / n)
+        return best
+
+    enabled = run(tracing.Tracer(capacity=4096, enabled=True))
+    disabled = run(tracing.Tracer(capacity=4096, enabled=False))
+    return enabled, disabled
+
+
+def main() -> int:
+    sys.path.insert(0, REPO)
+    problems = find_ad_hoc_spans()
+    for p in problems:
+        print(f"LINT: {p}")
+    enabled, disabled = measure_overhead()
+    print(f"span overhead: enabled {enabled * 1e6:.2f} us "
+          f"(budget {ENABLED_BUDGET_S * 1e6:.0f}), "
+          f"disabled {disabled * 1e6:.2f} us "
+          f"(budget {DISABLED_BUDGET_S * 1e6:.0f})")
+    ok = not problems
+    if enabled > ENABLED_BUDGET_S:
+        print("FAIL: enabled per-span overhead over budget")
+        ok = False
+    if disabled > DISABLED_BUDGET_S:
+        print("FAIL: disabled per-span overhead over budget")
+        ok = False
+    print("OK" if ok else "FAILED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
