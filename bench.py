@@ -141,6 +141,7 @@ def worker():
 
     import jax
 
+    from tendermint_tpu.libs import metrics as tmetrics
     from tendermint_tpu.libs.tracing import TRACER
 
     def stage_breakdown():
@@ -149,6 +150,17 @@ def worker():
         dispatch/readback attribution rides in every BENCH line
         instead of a single end-to-end number."""
         return TRACER.stage_rollup(prefix="crypto.")
+
+    def metrics_before():
+        """Snapshot the process /metrics registry before a measured
+        stage; the delta (counter increments + histogram quantiles,
+        incl. the bridge-fed tpu_* stage histograms) rides in the
+        BENCH line next to stage_breakdown, so the perf trajectory
+        records device telemetry per run."""
+        return tmetrics.snapshot()
+
+    def metrics_delta(before):
+        return tmetrics.delta(before, tmetrics.snapshot())
 
     device = str(jax.devices()[0])
     common = {
@@ -173,10 +185,12 @@ def worker():
     idx1k = list(range(n1k))
     assert bool(exp1k.verify(idx1k, msgs[:n1k], sigs[:n1k]).all())
     TRACER.clear()  # rollup covers the measured reps only, not warm-up
+    m0 = metrics_before()
     p50_1k = _measure(
         lambda: exp1k.verify(idx1k, msgs[:n1k], sigs[:n1k]), 7, warmed=True)
     line1k = {
         "stage_breakdown": stage_breakdown(),
+        "metrics_delta": metrics_delta(m0),
         **common,
         "value": round(p50_1k * 1e3 * (n / n1k), 3),  # scaled projection
         "vs_baseline": round(cpu_per_sig * n1k / p50_1k, 2),
@@ -228,8 +242,10 @@ def worker():
     idx = list(range(n))
     assert bool(exp.verify(idx, msgs, sigs).all()), "bench batch must verify"
     TRACER.clear()
+    m0 = metrics_before()
     p50 = _measure(lambda: exp.verify(idx, msgs, sigs), 7, warmed=True)
     stages = stage_breakdown()
+    mdelta = metrics_delta(m0)
 
     # The headline number is on record NOW — the diagnostic extras
     # below each trigger fresh XLA compiles (new shapes), i.e. fresh
@@ -243,6 +259,7 @@ def worker():
         "batch": n,
         "expanded_valset": True,
         "stage_breakdown": stages,
+        "metrics_delta": mdelta,
     }
     _emit(line)
 
@@ -320,8 +337,10 @@ def worker():
         return exp.verify_structured(idxs, sb, csigs)
 
     TRACER.clear()
+    m0 = metrics_before()
     p50_s = _measure(run_structured, 7, warmed=True)
     stages_structured = stage_breakdown()
+    mdelta_structured = metrics_delta(m0)
     # The recorded headline is the BEST product path for THIS real
     # commit, compared apples-to-apples: the bytes path timed on the
     # SAME ~187-byte canonical sign bytes (stage 2's number above used
@@ -354,6 +373,7 @@ def worker():
         "device_exec_ms_per_launch":
             line.get("device_exec_ms_per_launch"),
         "stage_breakdown": stages_structured,
+        "metrics_delta": mdelta_structured,
     }
     _emit(line_s)
 

@@ -208,6 +208,9 @@ class BlockchainReactor(Reactor):
         elif isinstance(msg, NoBlockResponseMessage):
             self.pool.no_block(peer.id, msg.height)
         elif isinstance(msg, BlockResponseMessage):
+            from ..libs.metrics import blockchain_metrics
+
+            blockchain_metrics().block_bytes_received.inc(len(msgb))
             self.pool.add_block(peer.id, msg.block, len(msgb))
         else:
             raise ValueError(f"unknown blockchain msg {type(msg)}")
@@ -215,11 +218,17 @@ class BlockchainReactor(Reactor):
     # -- sync driver --
 
     async def _pool_routine(self) -> None:
+        from ..libs.metrics import blockchain_metrics
+
+        bmet = blockchain_metrics()
         last_status = 0.0
         last_switch_check = 0.0
         try:
             while True:
                 now = time.monotonic()
+                bmet.pool_height.set(self.pool.height)
+                bmet.pending_requests.set(len(self.pool.requests))
+                bmet.num_peers.set(len(self.pool.peers))
                 # expire slow/dead peers
                 for pid in self.pool.tick(now):
                     self.pool.remove_peer(pid)
@@ -330,6 +339,9 @@ class BlockchainReactor(Reactor):
                 self.state, bid, first)
             self.blocks_synced += 1
             applied += 1
+            from ..libs.metrics import blockchain_metrics
+
+            blockchain_metrics().blocks_synced.inc()
             if self.state.validators.hash() != assumed_vals_hash:
                 # validator set changed mid-window: the remaining
                 # verdicts were computed against the wrong set — leave

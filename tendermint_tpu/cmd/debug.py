@@ -13,6 +13,8 @@ Both commands aggregate, into a .tar.gz archive:
   trace.json            debug server /debug/trace (span timeline,
                         Chrome trace-event JSON for Perfetto)
   trace_rollup.json     per-span-kind p50/p95/p99 rollup
+  metrics.txt           debug server /metrics (Prometheus exposition)
+  node_health.json      debug server /status (liveness verdict)
   config.toml           the node's config file
   cs.wal/               copy of the consensus WAL directory
 
@@ -80,6 +82,8 @@ def _collect(tmp: str, rpc_addr: str, pprof_addr: str, home: str,
         ("/debug/pprof/heap", "heap.txt"),
         ("/debug/trace", "trace.json"),
         ("/debug/trace/rollup", "trace_rollup.json"),
+        ("/metrics", "metrics.txt"),
+        ("/status", "node_health.json"),
     ):
         try:
             data = _pprof_get(pprof_addr, path)

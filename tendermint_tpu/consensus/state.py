@@ -86,6 +86,7 @@ class ConsensusState(Service):
         # (vote, peer_id, pub_key) triples awaiting one device batch.
         self._vote_buf: list = []
         self._vote_pending = asyncio.Event()
+        self._tpu_metrics = None  # lazy tpu_metrics() handle (hot path)
         self._height_done = asyncio.Event()  # pulsed on every commit
         # reactor hooks: fn(event_name, payload); events: "step",
         # "proposal", "block_part", "vote", "has_vote", and the
@@ -805,6 +806,10 @@ class ConsensusState(Service):
         if rs.proposal_block_parts is None:
             return False
         added = rs.proposal_block_parts.add_part(msg.part)
+        if added:
+            from ..libs.metrics import consensus_metrics
+
+            consensus_metrics().block_parts.inc()
         if added and rs.proposal_block_parts.is_complete():
             data = rs.proposal_block_parts.assemble()
             block = Block.from_bytes(data)
@@ -862,6 +867,12 @@ class ConsensusState(Service):
         # through the expanded structured path (validator-index lanes
         # against the SAME set pk was resolved from).
         self._vote_buf.append((vote, peer_id, pk, vals))
+        m = self._tpu_metrics
+        if m is None:
+            from ..libs.metrics import tpu_metrics
+
+            self._tpu_metrics = m = tpu_metrics()
+        m.verify_queue_depth.set(len(self._vote_buf))
         self._vote_pending.set()
         return True
 
@@ -896,9 +907,10 @@ class ConsensusState(Service):
         return val.pub_key, vals
 
     async def _vote_scheduler(self) -> None:
-        from ..libs.metrics import consensus_metrics
+        from ..libs.metrics import consensus_metrics, tpu_metrics
 
         met = consensus_metrics()
+        tmet = tpu_metrics()
         loop = asyncio.get_running_loop()
         while True:
             await self._vote_pending.wait()
@@ -907,6 +919,7 @@ class ConsensusState(Service):
             if window > 0 and len(self._vote_buf) < self.config.vote_batch_max:
                 await asyncio.sleep(window)
             batch, self._vote_buf = self._vote_buf, []
+            tmet.verify_queue_depth.set(0)
             self._vote_pending.clear()
             if not batch:
                 continue

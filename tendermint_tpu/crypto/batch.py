@@ -135,6 +135,11 @@ class BatchVerifier:
                         "device ed25519 batch failed (%d lanes); "
                         "degrading to host for %.0fs",
                         len(items), DEVICE_RETRY_COOLDOWN_S)
+            if use_dev:
+                # device wanted (threshold met) but unavailable/failed
+                from ..libs.metrics import tpu_metrics
+
+                tpu_metrics().host_fallbacks.inc()
             met.batch_lanes.inc(len(items), backend="host")
             # Host path: the per-key OpenSSL fast path (strict-accept ->
             # accept; reject -> ZIP-215 oracle recheck, crypto/ed25519.py).
@@ -171,6 +176,10 @@ class BatchVerifier:
                         "device sr25519 batch failed (%d lanes); "
                         "degrading to host for %.0fs",
                         len(items), DEVICE_RETRY_COOLDOWN_S)
+            if use_dev:
+                from ..libs.metrics import tpu_metrics
+
+                tpu_metrics().host_fallbacks.inc()
             # Degraded-mode fast path: the same kernel pinned to the
             # XLA CPU backend. The pure-Python oracle costs ~5.5
             # ms/sig — a device outage on an sr25519-heavy chain would

@@ -305,6 +305,7 @@ class ExpandedKeys:
         a_raw = np.frombuffer(b"".join(self.pubkeys), np.uint8).reshape(-1, 32)
         v = len(self.pubkeys)
         if v <= self.BUILD_CHUNK:
+            tv.count_compile("table_builder", (v,))
             tables, ok = _builder()(jnp.asarray(a_raw))
         else:
             # Pad to a chunk multiple (one compiled shape), build each
@@ -315,6 +316,7 @@ class ExpandedKeys:
             padded = np.zeros((vp, 32), np.uint8)
             padded[:v] = a_raw
             t_parts, ok_parts = [], []
+            tv.count_compile("table_builder", (chunk,))
             for s in range(0, vp, chunk):
                 t, o = _builder()(jnp.asarray(padded[s:s + chunk]))
                 t_parts.append(t)
@@ -434,6 +436,8 @@ class ExpandedKeys:
 
     def _launch(self, idx, packed):
         """Device side of verify: one kernel launch over packed lanes."""
+        tv.count_compile("expanded",
+                         (idx.shape[0], packed["msg"].shape[1]))
         idx, packed, btab = self._shard_args(idx, packed)
         return _xkernel(WINDOWS_PER_ITER)(
             idx=idx,
@@ -468,6 +472,9 @@ class ExpandedKeys:
         and /debug/trace report. `prepare` returns (launch_args,
         well_formed); `launch(*launch_args)` returns the device
         verdict array."""
+        from ...libs.metrics import tpu_metrics
+
+        tpu_metrics().batch_occupancy.observe(n / self._bucket(n))
         t = tracing.TRACER
         with t.span(tracing.CRYPTO_VERIFY, lanes=n, backend=backend):
             with t.span(tracing.CRYPTO_PACK, lanes=n):
@@ -544,6 +551,7 @@ class ExpandedKeys:
         return idx, fields, well_formed, width
 
     def _launch_structured(self, idx, fields, width):
+        tv.count_compile("structured", (idx.shape[0], width))
         idx, fields, btab = self._shard_args(
             idx, fields, repl_keys=("pre", "pre_len", "suf", "suf_len"))
         return _skernel(WINDOWS_PER_ITER)(
@@ -609,12 +617,16 @@ def max_keys() -> int:
 
 
 def get_expanded(pubkeys: list[bytes]) -> ExpandedKeys:
+    from ...libs.metrics import tpu_metrics
+
+    tmet = tpu_metrics()
     key = hashlib.sha256(b"".join(pubkeys)).digest()
     while True:
         with _CACHE_LOCK:
             exp = _CACHE.get(key)
             if exp is not None:
                 _CACHE.move_to_end(key)
+                tmet.expanded_cache.inc(event="hit")
                 return exp
             ev = _BUILDS.get(key)
             if ev is None:
@@ -626,7 +638,9 @@ def get_expanded(pubkeys: list[bytes]) -> ExpandedKeys:
         # this thread claims the build itself.
         ev.wait()
     try:
-        exp = ExpandedKeys(pubkeys)
+        tmet.expanded_cache.inc(event="miss")
+        with tmet.expanded_build_seconds.time():
+            exp = ExpandedKeys(pubkeys)
         with _CACHE_LOCK:
             _CACHE[key] = exp
             while len(_CACHE) > _CACHE_MAX:

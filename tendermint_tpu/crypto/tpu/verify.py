@@ -323,9 +323,16 @@ def verify_batch(pubs, msgs, sigs) -> np.ndarray:
     out = np.empty(n, bool)
     start = 0
     pending = []
+    from ...libs.metrics import tpu_metrics
+
+    tmet = tpu_metrics()
+    sizes = _chunks(n)
+    tmet.batch_occupancy.observe(n / sum(sizes))
+    if len(sizes) > 1:
+        tmet.batch_splits.inc()
     t = tracing.TRACER
     with t.span(tracing.CRYPTO_VERIFY, lanes=n, backend="general"):
-        for size in _chunks(n):
+        for size in sizes:
             end = min(start + size, n)
             pending.append(
                 (start, end, _launch_chunk(pubs[start:end], msgs[start:end],
@@ -344,6 +351,24 @@ def verify_batch(pubs, msgs, sigs) -> np.ndarray:
     return out & well_formed
 
 
+# (kernel, shape) keys already launched: a first launch at a new shape
+# is what actually triggers an XLA trace+compile under @jax.jit, so
+# tpu_jit_compiles_total counts THESE — not the once-per-process
+# memoized wrapper builds, which would stay flat through a
+# shape-churn compile storm.
+_COMPILED_SHAPES: set[tuple] = set()
+
+
+def count_compile(kernel: str, shape: tuple) -> None:
+    key = (kernel,) + shape
+    if key in _COMPILED_SHAPES:
+        return
+    _COMPILED_SHAPES.add(key)
+    from ...libs.metrics import tpu_metrics
+
+    tpu_metrics().jit_compiles.inc(kernel=kernel)
+
+
 def _launch_chunk(pubs, msgs, sigs, bucket: int):
     """Dispatch one bucket-sized kernel launch; returns the device array
     (async — caller materializes). Padding lanes use a fixed valid
@@ -358,6 +383,7 @@ def _launch_chunk(pubs, msgs, sigs, bucket: int):
             msgs = list(msgs) + [dm] * pad
             sigs = list(sigs) + [ds] * pad
         packed = pack_batch(pubs, msgs, sigs)
+    count_compile("general", (bucket, packed["msg"].shape[1]))
     with t.span(tracing.CRYPTO_DISPATCH, lanes=bucket):
         btab = b_comb_tables()
         mesh = _mesh()

@@ -39,6 +39,14 @@ class Pool:
             ev = evidence_from_bytes(v)
             self.evidence_list.push_back(ev)
             self._pending_bytes += len(v)
+        self._set_pool_gauges()
+
+    def _set_pool_gauges(self) -> None:
+        from ..libs.metrics import evidence_metrics
+
+        met = evidence_metrics()
+        met.pool_size.set(len(self.evidence_list))
+        met.pool_bytes.set(self._pending_bytes)
 
     # -- queries --
 
@@ -68,6 +76,9 @@ class Pool:
             return
         ev.validate_basic()
         verify_evidence(ev, self.state, self.state_store, self.block_store)
+        from ..libs.metrics import evidence_metrics
+
+        evidence_metrics().verified.inc()
         self._persist_pending(ev)
         logger.info("added evidence %s h=%d", type(ev).__name__, ev.height())
 
@@ -85,6 +96,7 @@ class Pool:
         self.db.set(_key(_PENDING, ev), raw)
         self._pending_bytes += len(raw)
         self.evidence_list.push_back(ev)
+        self._set_pool_gauges()
 
     # -- block validation hook --
 
@@ -110,10 +122,14 @@ class Pool:
         """Mark committed, drop from pending, prune expired
         (reference: pool.go Update)."""
         self.state = state
+        from ..libs.metrics import evidence_metrics
+
+        evidence_metrics().committed.inc(len(committed))
         for ev in committed:
             self.db.set(_key(_COMMITTED, ev), b"\x01")
             self._remove_pending(ev)
         self._prune_expired()
+        self._set_pool_gauges()
 
     def _remove_pending(self, ev: Evidence) -> None:
         k = _key(_PENDING, ev)

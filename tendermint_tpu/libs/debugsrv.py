@@ -20,7 +20,12 @@ Reference: node/node.go:807-812 serves net/http/pprof on
                                windows to the trailing N s (default:
                                the whole ring)
   GET /debug/trace/rollup      per-span-kind p50/p95/p99 rollup JSON
-  GET /metrics                 Prometheus text exposition
+  GET /metrics                 Prometheus text exposition (full
+                               per-module catalog, materialized on
+                               scrape)
+  GET /status                  machine-readable node health: per-
+                               subsystem liveness checks aggregated
+                               into an ok/degraded/failing verdict
 
 Used by `tendermint-tpu debug kill|dump` (cmd/) to capture diagnostics
 bundles, mirroring cmd/tendermint/commands/debug/{kill,dump}.go.
@@ -32,9 +37,140 @@ import asyncio
 import io
 import logging
 import sys
+import time
 import traceback
 
 logger = logging.getLogger("debugsrv")
+
+# /status thresholds. "Advancing" is judged against the slow end of
+# sane block cadence, not the fast end: a 30 s gap on a 1 s-block
+# chain is already ten missed heights, while 120 s without a commit
+# means consensus is not making progress at any realistic cadence.
+HEALTH_STALL_DEGRADED_S = 30.0
+HEALTH_STALL_FAILING_S = 120.0
+HEALTH_MEMPOOL_DEGRADED = 0.80   # pool fill ratio
+HEALTH_MEMPOOL_FAILING = 0.95
+
+_RANK = {"ok": 0, "degraded": 1, "failing": 2}
+
+
+class HealthMonitor:
+    """Aggregates subsystem liveness into one verdict for GET /status.
+
+    Stateless reads come from the process-global metric singletons
+    (height, peers, mempool size) plus crypto.batch's device-cooldown
+    flag; the only state kept here is the (height, monotonic time)
+    pair of the last observed height advance, which turns the height
+    gauge into an is-it-moving check. An attached Node sharpens the
+    checks (mempool capacity, solo-validator exemption) but is
+    optional — a bare DebugServer still answers."""
+
+    def __init__(self, node=None,
+                 stall_degraded_s: float = HEALTH_STALL_DEGRADED_S,
+                 stall_failing_s: float = HEALTH_STALL_FAILING_S):
+        self.node = node
+        self.stall_degraded_s = stall_degraded_s
+        self.stall_failing_s = stall_failing_s
+        self._last_height: float | None = None
+        self._last_advance_t: float = time.monotonic()
+
+    def status(self) -> dict:
+        from ..crypto import batch as cbatch
+        from .metrics import (consensus_metrics, mempool_metrics,
+                              p2p_metrics, tpu_metrics)
+
+        now = time.monotonic()
+        checks: dict[str, dict] = {}
+
+        # -- consensus: is the height advancing? --
+        cm = consensus_metrics()
+        height = cm.height.value()
+        if self._last_height is None:
+            # First reading baselines the height but NOT the advance
+            # clock (that baselined at construction): a node stalled
+            # since boot must not look "advancing" on the first poll.
+            self._last_height = height
+        elif height > self._last_height:
+            self._last_height = height
+            self._last_advance_t = now
+        age = now - self._last_advance_t
+        syncing = bool(cm.fast_syncing.value() or cm.state_syncing.value())
+        if syncing:
+            c = {"status": "ok", "detail": "syncing"}
+        elif height == 0:
+            c = {"status": "degraded", "detail": "no height committed yet"}
+        elif age < self.stall_degraded_s:
+            c = {"status": "ok"}
+        elif age < self.stall_failing_s:
+            c = {"status": "degraded",
+                 "detail": f"height stalled {age:.0f}s"}
+        else:
+            c = {"status": "failing",
+                 "detail": f"height stalled {age:.0f}s"}
+        c["height"] = int(height)
+        c["last_advance_age_s"] = round(age, 1)
+        checks["consensus"] = c
+
+        # -- p2p: are we connected to anyone? --
+        node = self.node
+        if node is not None and getattr(node, "switch", None) is not None:
+            peers = node.switch.n_peers()
+        else:
+            peers = int(p2p_metrics().peers.value())
+        solo = False
+        if node is not None:
+            try:
+                solo = node._only_validator_is_us()
+            except Exception:
+                solo = False
+        if peers > 0:
+            checks["p2p"] = {"status": "ok", "peers": peers}
+        elif solo:
+            checks["p2p"] = {"status": "ok", "peers": 0,
+                             "detail": "solo validator"}
+        else:
+            checks["p2p"] = {"status": "degraded", "peers": 0,
+                             "detail": "no peers"}
+
+        # -- mempool: saturation --
+        if node is not None and getattr(node, "mempool", None) is not None:
+            size = node.mempool.size()
+            cap = node.config.mempool.size
+        else:
+            size = int(mempool_metrics().size.value())
+            cap = 0
+        mp: dict = {"size": size}
+        if cap > 0:
+            ratio = size / cap
+            mp["capacity"] = cap
+            mp["fill_ratio"] = round(ratio, 3)
+            if ratio >= HEALTH_MEMPOOL_FAILING:
+                mp["status"] = "failing"
+                mp["detail"] = "mempool saturated"
+            elif ratio >= HEALTH_MEMPOOL_DEGRADED:
+                mp["status"] = "degraded"
+                mp["detail"] = "mempool nearly full"
+            else:
+                mp["status"] = "ok"
+        else:
+            mp["status"] = "ok"
+        checks["mempool"] = mp
+
+        # -- device: is the accelerator serving, and is the verify
+        # queue draining? --
+        available = cbatch.device_available()
+        qdepth = int(tpu_metrics().verify_queue_depth.value())
+        dv: dict = {"queue_depth": qdepth}
+        if available:
+            dv["status"] = "ok"
+        else:
+            dv["status"] = "degraded"
+            dv["detail"] = "device cooldown: verifying on host"
+        checks["device"] = dv
+
+        overall = max((c["status"] for c in checks.values()),
+                      key=_RANK.__getitem__)
+        return {"status": overall, "checks": checks}
 
 
 def _goroutine_dump() -> str:
@@ -117,9 +253,10 @@ async def _profile(seconds: float) -> str:
 class DebugServer:
     """Tiny HTTP/1.0 server for the routes above."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, node=None):
         self.host = host
         self.port = port
+        self.health = HealthMonitor(node)
         self._server = None
 
     async def start(self) -> int:
@@ -168,7 +305,7 @@ class DebugServer:
     async def _route(self, path: str, params: dict) -> bytes:
         if path in ("/debug/pprof", "/debug/pprof/"):
             return (b"pprof endpoints: goroutine, heap?seconds=N, "
-                    b"profile?seconds=N; also /metrics, "
+                    b"profile?seconds=N; also /metrics, /status, "
                     b"/debug/trace?seconds=N, /debug/trace/rollup\n")
         if path == "/debug/pprof/goroutine":
             return _goroutine_dump().encode()
@@ -203,7 +340,15 @@ class DebugServer:
                     TRACER.stage_rollup(seconds=secs or None)).encode())
             return body, b"application/json"
         if path == "/metrics":
-            from .metrics import DEFAULT
+            from .metrics import DEFAULT, node_metrics
 
+            # A scrape must show the full per-module catalog even on a
+            # node nothing has recorded into yet (idempotent, cheap).
+            node_metrics()
             return DEFAULT.render_text().encode()
+        if path == "/status":
+            import json
+
+            return (json.dumps(self.health.status()).encode(),
+                    b"application/json")
         return b"unknown path; see /debug/pprof/\n"

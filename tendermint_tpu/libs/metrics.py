@@ -11,21 +11,49 @@ Implementation is deliberately tiny and allocation-light: consensus
 hot paths (vote batches, device launches) record into plain floats
 under no lock — the event-loop/worker structure makes races harmless
 for monitoring data, same stance as Prometheus client libs' relaxed
-atomicity on Python.
+atomicity on Python. The one consistency guarantee render() DOES make:
+a histogram's cumulative buckets, `_count` and `+Inf` are derived from
+a single snapshot of the bucket array, so concurrent observes (the
+BatchVerifier executor threads) can never produce exposition output
+where `+Inf` != `_count` or the cumulative sequence decreases. `_sum`
+may lag the buckets by in-flight observes — relaxed, like counters.
+
+The tracing→metrics bridge at the bottom of this module makes every
+registered span kind (libs/tracing.py) populate a histogram on span
+close: one instrumentation point, two exports. The device-pipeline
+kinds (crypto.pack/dispatch/device_exec/readback) feed the dedicated
+`tpu_*_seconds` histograms; every other kind feeds
+`tracing_span_seconds{kind=...}`.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, fields as dc_fields
+
+from . import tracing as _tracing
+
+
+def _escape_label_value(v: str) -> str:
+    """Exposition-format label-value escaping: backslash, double-quote
+    and newline emitted raw produce unparseable output for values like
+    peer addresses or chain ids (text format spec, label_value)."""
+    return (str(v).replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(h: str) -> str:
+    """HELP lines escape backslash and newline (text format spec)."""
+    return h.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt_labels(labels: dict[str, str] | None) -> str:
     if not labels:
         return ""
     inner = ",".join(
-        f'{k}="{v}"' for k, v in sorted(labels.items())
+        f'{k}="{_escape_label_value(v)}"' for k, v in sorted(labels.items())
     )
     return "{" + inner + "}"
 
@@ -39,6 +67,7 @@ def _fmt_value(v: float) -> str:
 class Metric:
     def __init__(self, name: str, help_: str, namespace: str = ""):
         self.name = f"{namespace}_{name}" if namespace else name
+        self.namespace = namespace
         self.help = help_
 
     def render(self) -> list[str]:  # pragma: no cover - abstract
@@ -60,7 +89,7 @@ class Counter(Metric):
         return self._values.get(tuple(sorted(labels.items())), 0.0)
 
     def render(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}",
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
                f"# TYPE {self.name} {self.kind}"]
         for key, v in sorted(self._values.items()):
             out.append(f"{self.name}{_fmt_labels(dict(key))} {_fmt_value(v)}")
@@ -85,61 +114,126 @@ _DEFAULT_BUCKETS = (
 )
 
 
+class _Series:
+    """One labelset's state: a bucket-count array and a running sum."""
+
+    __slots__ = ("counts", "sum")
+
+    def __init__(self, nbuckets: int):
+        self.counts = [0] * (nbuckets + 1)
+        self.sum = 0.0
+
+
+class _BoundHistogram:
+    """A histogram pre-resolved to one labelset: observe() is a bucket
+    scan + two plain increments, no label handling per call — the
+    handle the tracing bridge caches per span kind."""
+
+    __slots__ = ("_buckets", "_series")
+
+    def __init__(self, buckets: tuple, series: _Series):
+        self._buckets = buckets
+        self._series = series
+
+    def observe(self, value: float) -> None:
+        s = self._series
+        s.sum += value
+        for i, b in enumerate(self._buckets):
+            if value <= b:
+                s.counts[i] += 1
+                return
+        s.counts[-1] += 1
+
+
 class Histogram(Metric):
+    """Histogram with optional labels: `observe(v)` records into the
+    unlabelled series, `observe(v, ch="0x20")` into a labelled one,
+    `labels(ch="0x20")` returns a bound handle for hot paths."""
+
     kind = "histogram"
 
     def __init__(self, name: str, help_: str, namespace: str = "",
                  buckets: tuple = _DEFAULT_BUCKETS):
         super().__init__(name, help_, namespace)
         self.buckets = tuple(sorted(buckets))
-        self._counts = [0] * (len(self.buckets) + 1)
-        self._sum = 0.0
-        self._n = 0
+        self._series: dict[tuple, _Series] = {}
+        self._series_lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
-        self._sum += value
-        self._n += 1
+    def _series_for(self, key: tuple) -> _Series:
+        s = self._series.get(key)
+        if s is None:
+            # creation is the only guarded op: a first-observe race
+            # from two threads must not drop a whole series
+            with self._series_lock:
+                s = self._series.setdefault(
+                    key, _Series(len(self.buckets)))
+        return s
+
+    def labels(self, **labels) -> _BoundHistogram:
+        key = tuple(sorted(labels.items()))
+        return _BoundHistogram(self.buckets, self._series_for(key))
+
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items())) if labels else ()
+        s = self._series_for(key)
+        s.sum += value
         for i, b in enumerate(self.buckets):
             if value <= b:
-                self._counts[i] += 1
+                s.counts[i] += 1
                 return
-        self._counts[-1] += 1
+        s.counts[-1] += 1
 
     @property
     def count(self) -> int:
-        return self._n
+        return sum(sum(s.counts) for s in self._series.values())
 
     @property
     def sum(self) -> float:
-        return self._sum
+        return sum(s.sum for s in self._series.values())
 
     def render(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}",
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
                f"# TYPE {self.name} histogram"]
-        cum = 0
-        for i, b in enumerate(self.buckets):
-            cum += self._counts[i]
-            out.append(f'{self.name}_bucket{{le="{_fmt_value(b)}"}} {cum}')
-        cum += self._counts[-1]
-        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
-        out.append(f"{self.name}_sum {_fmt_value(self._sum)}")
-        out.append(f"{self.name}_count {self._n}")
+        series = sorted(self._series.items()) or [((), _Series(
+            len(self.buckets)))]
+        for key, s in series:
+            # ONE snapshot of the bucket array per series: cumulative
+            # buckets, +Inf and _count all derive from it, so a
+            # concurrent observe (executor threads) can never render
+            # +Inf != _count or a non-monotone cumulative sequence.
+            counts = list(s.counts)
+            lbl = dict(key)
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += counts[i]
+                out.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels({**lbl, 'le': _fmt_value(b)})} {cum}")
+            cum += counts[-1]
+            out.append(
+                f"{self.name}_bucket"
+                f"{_fmt_labels({**lbl, 'le': '+Inf'})} {cum}")
+            out.append(f"{self.name}_sum{_fmt_labels(lbl)} "
+                       f"{_fmt_value(s.sum)}")
+            out.append(f"{self.name}_count{_fmt_labels(lbl)} {cum}")
         return out
 
     class _Timer:
-        def __init__(self, h: "Histogram"):
-            self._h = h
+        def __init__(self, observe):
+            self._observe = observe
 
         def __enter__(self):
             self._t0 = time.perf_counter()
             return self
 
         def __exit__(self, *exc):
-            self._h.observe(time.perf_counter() - self._t0)
+            self._observe(time.perf_counter() - self._t0)
             return False
 
-    def time(self) -> "_Timer":
-        return self._Timer(self)
+    def time(self, **labels) -> "Histogram._Timer":
+        if labels:
+            return self._Timer(self.labels(**labels).observe)
+        return self._Timer(self.observe)
 
 
 class Registry:
@@ -228,6 +322,8 @@ class ConsensusMetrics:
             buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60)))
     fast_sync_blocks: Counter = field(default_factory=lambda: DEFAULT.counter(
         "fast_sync_blocks", "Blocks applied via fast sync.", "consensus"))
+    block_parts: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "block_parts", "Block parts received and added.", "consensus"))
     # --- TPU batch-verify observability (new capability; no reference
     # equivalent): these are the numbers that justify _DEVICE_THRESHOLD
     # and the micro-batch window empirically.
@@ -276,6 +372,13 @@ class P2PMetrics:
         "peer_send_bytes_total", "Bytes sent, by channel.", "p2p"))
     pending_send_bytes: Gauge = field(default_factory=lambda: DEFAULT.gauge(
         "pending_send_bytes", "Pending bytes across peers.", "p2p"))
+    message_receive: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "message_receive_total", "Complete messages received, by channel.",
+        "p2p"))
+    message_send: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "message_send_total", "Complete messages sent, by channel.", "p2p"))
+    num_txs: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "num_txs", "Transactions received from peers.", "p2p"))
 
 
 @dataclass
@@ -283,6 +386,9 @@ class MempoolMetrics:
     """reference: mempool/metrics.go."""
     size: Gauge = field(default_factory=lambda: DEFAULT.gauge(
         "size", "Transactions in the mempool.", "mempool"))
+    tx_bytes: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "tx_bytes", "Total bytes of transactions in the mempool.",
+        "mempool"))
     tx_size_bytes: Histogram = field(default_factory=lambda: DEFAULT.histogram(
         "tx_size_bytes", "Transaction sizes.", "mempool",
         buckets=(32, 128, 512, 2048, 8192, 32768, 131072)))
@@ -290,6 +396,57 @@ class MempoolMetrics:
         "failed_txs", "CheckTx rejections.", "mempool"))
     recheck_times: Counter = field(default_factory=lambda: DEFAULT.counter(
         "recheck_times", "Transactions rechecked after commit.", "mempool"))
+
+
+@dataclass
+class BlockchainMetrics:
+    """Fast-sync pool instrumentation (reference has no blocksync
+    metrics in v0.34; names follow the pool's own vocabulary)."""
+    pool_height: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "pool_height", "Next height the fast-sync pool will fetch.",
+        "blockchain"))
+    pending_requests: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "pending_requests", "In-flight block requests.", "blockchain"))
+    num_peers: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "num_peers", "Peers the fast-sync pool can fetch from.",
+        "blockchain"))
+    blocks_synced: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "blocks_synced_total", "Blocks verified and applied by fast sync.",
+        "blockchain"))
+    block_bytes_received: Counter = field(
+        default_factory=lambda: DEFAULT.counter(
+            "block_bytes_received_total",
+            "Block-response bytes received from peers.", "blockchain"))
+
+
+@dataclass
+class StateSyncMetrics:
+    """Snapshot-restore instrumentation (reference: statesync/)."""
+    syncing: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "syncing", "1 while state sync is running.", "statesync"))
+    snapshots_discovered: Counter = field(
+        default_factory=lambda: DEFAULT.counter(
+            "snapshots_discovered_total",
+            "Snapshot advertisements received from peers.", "statesync"))
+    chunks_received: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "chunks_received_total", "Snapshot chunks received.", "statesync"))
+    chunks_served: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "chunks_served_total", "Snapshot chunks served to peers.",
+        "statesync"))
+
+
+@dataclass
+class EvidenceMetrics:
+    """reference: evidence/metrics.go (pool size) + admission counters."""
+    pool_size: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "pool_size", "Pending evidence in the pool.", "evidence"))
+    pool_bytes: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "pool_bytes", "Bytes of pending evidence in the pool.", "evidence"))
+    verified: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "verified_total", "Evidence verified and admitted to the pool.",
+        "evidence"))
+    committed: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "committed_total", "Evidence committed in blocks.", "evidence"))
 
 
 @dataclass
@@ -302,6 +459,84 @@ class StateMetrics:
         default_factory=lambda: DEFAULT.histogram(
             "commit_verify_seconds",
             "LastCommit signature-batch wall time.", "state"))
+    validator_set_updates: Counter = field(
+        default_factory=lambda: DEFAULT.counter(
+            "validator_set_updates_total",
+            "Validator updates applied from EndBlock.", "state"))
+    consensus_param_updates: Counter = field(
+        default_factory=lambda: DEFAULT.counter(
+            "consensus_param_updates_total",
+            "Consensus-parameter updates applied from EndBlock.", "state"))
+
+
+@dataclass
+class ABCIMetrics:
+    """Per-method ABCI connection latency (reference: the per-method
+    `abci_connection_method_timing_seconds` added in later lines)."""
+    method_seconds: Histogram = field(
+        default_factory=lambda: DEFAULT.histogram(
+            "connection_method_seconds",
+            "ABCI call latency, by connection and method.", "abci"))
+
+
+@dataclass
+class TPUMetrics:
+    """Device verify-pipeline telemetry (new capability; no reference
+    equivalent). The four stage histograms are fed by the
+    tracing→metrics bridge from existing span closes — no extra
+    instrumentation sites in the hot path."""
+    verify_queue_depth: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "verify_queue_depth",
+        "Votes waiting in the micro-batch verify queue.", "tpu"))
+    batch_occupancy: Histogram = field(
+        default_factory=lambda: DEFAULT.histogram(
+            "batch_occupancy_ratio",
+            "Real lanes / padded bucket size per device batch.", "tpu",
+            buckets=(0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8,
+                     0.9, 1.0)))
+    pack_seconds: Histogram = field(default_factory=lambda: DEFAULT.histogram(
+        "pack_seconds", "Host byte-packing time per launch "
+        "(bridge-fed from crypto.pack spans).", "tpu"))
+    dispatch_seconds: Histogram = field(
+        default_factory=lambda: DEFAULT.histogram(
+            "dispatch_seconds", "Kernel-launch enqueue time "
+            "(bridge-fed from crypto.dispatch spans).", "tpu"))
+    device_exec_seconds: Histogram = field(
+        default_factory=lambda: DEFAULT.histogram(
+            "device_exec_seconds", "Wait-until-verdicts-ready time "
+            "(bridge-fed from crypto.device_exec spans).", "tpu"))
+    readback_seconds: Histogram = field(
+        default_factory=lambda: DEFAULT.histogram(
+            "readback_seconds", "Device-to-host verdict copy time "
+            "(bridge-fed from crypto.readback spans).", "tpu"))
+    host_fallbacks: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "host_fallbacks_total",
+        "Batches that wanted the device but verified on host.", "tpu"))
+    batch_splits: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "batch_splits_total",
+        "Verifies split into multiple launches (batch > max bucket).",
+        "tpu"))
+    jit_compiles: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "jit_compiles_total",
+        "First launches at a new kernel shape (each triggers an XLA "
+        "trace+compile), by kernel.", "tpu"))
+    expanded_cache: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "expanded_cache_events_total",
+        "Expanded-valset table cache hits/misses.", "tpu"))
+    expanded_build_seconds: Histogram = field(
+        default_factory=lambda: DEFAULT.histogram(
+            "expanded_build_seconds",
+            "Wall time building expanded comb tables for a valset.", "tpu",
+            buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120)))
+
+
+@dataclass
+class TracingMetrics:
+    """The generic half of the tracing→metrics bridge: span kinds with
+    no dedicated histogram land here, labelled by kind."""
+    span_seconds: Histogram = field(default_factory=lambda: DEFAULT.histogram(
+        "span_seconds", "Span duration by registered kind "
+        "(bridge-fed from every span close).", "tracing"))
 
 
 _SINGLETONS: dict[str, object] = {}
@@ -337,5 +572,205 @@ def mempool_metrics() -> MempoolMetrics:
     return _singleton("mempool", MempoolMetrics)
 
 
+def blockchain_metrics() -> BlockchainMetrics:
+    return _singleton("blockchain", BlockchainMetrics)
+
+
+def statesync_metrics() -> StateSyncMetrics:
+    return _singleton("statesync", StateSyncMetrics)
+
+
+def evidence_metrics() -> EvidenceMetrics:
+    return _singleton("evidence", EvidenceMetrics)
+
+
 def state_metrics() -> StateMetrics:
     return _singleton("state", StateMetrics)
+
+
+def abci_metrics() -> ABCIMetrics:
+    return _singleton("abci", ABCIMetrics)
+
+
+def tpu_metrics() -> TPUMetrics:
+    return _singleton("tpu", TPUMetrics)
+
+
+def tracing_metrics() -> TracingMetrics:
+    return _singleton("tracing", TracingMetrics)
+
+
+# ------------------------------------------------- MetricsProvider wiring
+
+@dataclass
+class NodeMetrics:
+    """The full per-module bundle one node records into — what the
+    reference's MetricsProvider returns per subsystem
+    (node/node.go:110-125), collapsed into one object because our
+    modules share process-global singletons."""
+
+    consensus: ConsensusMetrics
+    crypto: CryptoMetrics
+    p2p: P2PMetrics
+    mempool: MempoolMetrics
+    blockchain: BlockchainMetrics
+    statesync: StateSyncMetrics
+    evidence: EvidenceMetrics
+    state: StateMetrics
+    abci: ABCIMetrics
+    tpu: TPUMetrics
+    tracing: TracingMetrics
+
+
+def node_metrics() -> NodeMetrics:
+    """Materialize every per-module metric family (idempotent). A
+    scrape of a freshly-started node must show the full catalog, not
+    just the families something has already recorded into."""
+    return NodeMetrics(
+        consensus=consensus_metrics(), crypto=crypto_metrics(),
+        p2p=p2p_metrics(), mempool=mempool_metrics(),
+        blockchain=blockchain_metrics(), statesync=statesync_metrics(),
+        evidence=evidence_metrics(), state=state_metrics(),
+        abci=abci_metrics(), tpu=tpu_metrics(),
+        tracing=tracing_metrics(),
+    )
+
+
+def metrics_provider(instrumentation):
+    """reference: node/node.go:110-125 DefaultMetricsProvider — with
+    `instrumentation.prometheus` on, the node eagerly constructs every
+    subsystem's metric family at build time (so the first scrape is
+    complete); off, modules keep lazily materializing only what they
+    record into, the Nop analogue."""
+    def provider(chain_id: str) -> NodeMetrics | None:
+        if instrumentation.prometheus:
+            return node_metrics()
+        return None
+
+    return provider
+
+
+def all_module_metrics() -> dict[str, Metric]:
+    """{metric_name: Metric} over every dataclass field of the full
+    bundle — the declared catalog tools/check_metrics.py lints
+    against."""
+    out: dict[str, Metric] = {}
+    nm = node_metrics()
+    for module_field in dc_fields(nm):
+        bundle = getattr(nm, module_field.name)
+        for f in dc_fields(bundle):
+            m = getattr(bundle, f.name)
+            out[m.name] = m
+    return out
+
+
+# ------------------------------------------------ snapshot / delta (bench)
+
+def snapshot(registry: Registry | None = None) -> dict:
+    """Point-in-time copy of every metric's values, keyed by
+    `name{labels}`. Counters/gauges map to floats; histograms to
+    {"buckets": (...), "counts": [...], "sum": s}. Input to delta()."""
+    reg = registry or DEFAULT
+    with reg._lock:
+        metrics = list(reg._metrics)
+    out: dict = {}
+    for m in metrics:
+        if isinstance(m, Histogram):
+            for key, s in list(m._series.items()):
+                out[m.name + _fmt_labels(dict(key))] = {
+                    "buckets": m.buckets,
+                    "counts": list(s.counts),
+                    "sum": s.sum,
+                }
+        else:
+            for key, v in list(m._values.items()):
+                out[m.name + _fmt_labels(dict(key))] = v
+    return out
+
+
+def _bucket_quantile(buckets, counts, q: float):
+    """Prometheus-style histogram_quantile over one bucket-count
+    vector: linear interpolation inside the bucket; the overflow
+    bucket clamps to the largest finite bound."""
+    total = sum(counts)
+    if total == 0:
+        return None
+    rank = q * total
+    cum = 0.0
+    lo = 0.0
+    for i, b in enumerate(buckets):
+        prev = cum
+        cum += counts[i]
+        if cum >= rank:
+            frac = (rank - prev) / counts[i] if counts[i] else 0.0
+            return lo + (b - lo) * frac
+        lo = b
+    return buckets[-1]
+
+
+def delta(before: dict, after: dict) -> dict:
+    """What changed between two snapshot()s: counter/gauge increments
+    (nonzero only) and, per histogram series with new observations,
+    the count/sum delta plus p50/p95/p99 estimated from the bucket
+    deltas — the BENCH `metrics_delta` payload."""
+    out: dict = {}
+    for key, val in after.items():
+        prev = before.get(key)
+        if isinstance(val, dict):
+            pcounts = prev["counts"] if isinstance(prev, dict) \
+                else [0] * len(val["counts"])
+            dcounts = [a - b for a, b in zip(val["counts"], pcounts)]
+            n = sum(dcounts)
+            if n <= 0:
+                continue
+            psum = prev["sum"] if isinstance(prev, dict) else 0.0
+            finite = val["buckets"]
+            out[key] = {
+                "count": n,
+                "sum": round(val["sum"] - psum, 6),
+                "p50": _bucket_quantile(finite, dcounts, 0.50),
+                "p95": _bucket_quantile(finite, dcounts, 0.95),
+                "p99": _bucket_quantile(finite, dcounts, 0.99),
+            }
+        else:
+            d = val - (prev if isinstance(prev, float) else 0.0)
+            if d != 0:
+                out[key] = round(d, 6)
+    return out
+
+
+# ------------------------------------------------ tracing→metrics bridge
+
+# Span kinds with a dedicated histogram; resolved lazily so importing
+# this module does not force-construct the tpu family.
+_BRIDGE_DEDICATED = {
+    _tracing.CRYPTO_PACK: lambda: tpu_metrics().pack_seconds,
+    _tracing.CRYPTO_DISPATCH: lambda: tpu_metrics().dispatch_seconds,
+    _tracing.CRYPTO_DEVICE_EXEC: lambda: tpu_metrics().device_exec_seconds,
+    _tracing.CRYPTO_READBACK: lambda: tpu_metrics().readback_seconds,
+}
+_BRIDGE_CACHE: dict[str, object] = {}
+
+
+def span_metrics_sink(kind: str, seconds: float) -> None:
+    """Installed into the global TRACER: every span close observes one
+    histogram — the dedicated tpu stage histogram for the device
+    pipeline kinds, tracing_span_seconds{kind=...} for the rest. The
+    per-close cost is one dict lookup + one bucket scan (the bound
+    handle is cached per kind), inside the tools/check_spans.py
+    per-span overhead budget."""
+    ob = _BRIDGE_CACHE.get(kind)
+    if ob is None:
+        mk = _BRIDGE_DEDICATED.get(kind)
+        if mk is not None:
+            h = mk()
+            ob = _BoundHistogram(h.buckets, h._series_for(()))
+        else:
+            ob = tracing_metrics().span_seconds.labels(kind=kind)
+        _BRIDGE_CACHE[kind] = ob
+    ob.observe(seconds)
+
+
+# One instrumentation point, two exports: the ring buffer keeps the
+# per-event timeline, the sink keeps the aggregate histograms.
+_tracing.TRACER.set_metrics_sink(span_metrics_sink)

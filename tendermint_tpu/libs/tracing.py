@@ -151,10 +151,23 @@ class Span:
             return
         self._done = True
         t1 = time.perf_counter_ns()
-        self._tracer._ring.append((
+        tracer = self._tracer
+        tracer._ring.append((
             self.kind, self.span_id, self.parent_id, self.tid,
             self.t0, t1 - self.t0, self.attrs,
         ))
+        # tracing→metrics bridge: the same close feeds the kind's
+        # Prometheus histogram (libs/metrics.py span_metrics_sink) —
+        # one instrumentation point, two exports. Monitoring must
+        # never take down the instrumented path, hence the blanket
+        # except; the sink itself is a dict lookup + bucket scan,
+        # inside the tools/check_spans.py per-span budget.
+        sink = tracer.metrics_sink
+        if sink is not None:
+            try:
+                sink(self.kind, (t1 - self.t0) / 1e9)
+            except Exception:
+                pass
 
 
 class _NoopSpan:
@@ -234,6 +247,13 @@ class Tracer:
         self.capacity = capacity
         self.enabled = enabled
         self._ring: deque = deque(maxlen=capacity)
+        # tracing→metrics bridge: fn(kind, seconds) called on every
+        # span close (libs/metrics.py installs span_metrics_sink on
+        # the global TRACER). None = no bridge (private test tracers).
+        self.metrics_sink = None
+
+    def set_metrics_sink(self, sink) -> None:
+        self.metrics_sink = sink
 
     # -- recording --
 

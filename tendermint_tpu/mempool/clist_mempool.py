@@ -252,6 +252,7 @@ class CListMempool(Mempool):
 
         met = mempool_metrics()
         met.size.set(self.size())
+        met.tx_bytes.set(self._tx_bytes)
         met.tx_size_bytes.observe(len(tx))
         if self._wal:
             # buffered; flushed per block in _rewrite_wal (a hard crash
@@ -324,7 +325,9 @@ class CListMempool(Mempool):
             await self._recheck_txs()
         from ..libs.metrics import mempool_metrics
 
-        mempool_metrics().size.set(self.size())
+        met = mempool_metrics()
+        met.size.set(self.size())
+        met.tx_bytes.set(self._tx_bytes)
         self._rewrite_wal()
         if self.size() == 0:
             self._notify_available.clear()
@@ -364,4 +367,9 @@ class CListMempool(Mempool):
         self._tx_bytes = 0
         self.cache.reset()
         self._notify_available.clear()
+        from ..libs.metrics import mempool_metrics
+
+        met = mempool_metrics()
+        met.size.set(0)
+        met.tx_bytes.set(0)
         self._rewrite_wal()

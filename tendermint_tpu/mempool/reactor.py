@@ -75,6 +75,9 @@ class MempoolReactor(Reactor):
         txs = decode_txs(msgb)
         if not txs:
             raise ValueError("empty mempool message")
+        from ..libs.metrics import p2p_metrics
+
+        p2p_metrics().num_txs.inc(len(txs))
         for tx in txs:
             try:
                 await self.mempool.check_tx(tx, {"sender": peer.id})

@@ -156,6 +156,15 @@ class Node(Service):
 
     async def _build(self) -> None:
         cfg = self.config
+        # MetricsProvider path (reference node.go:110-125): with
+        # instrumentation.prometheus on, every subsystem's metric
+        # family is constructed here, before any subsystem starts, so
+        # the first scrape shows the whole catalog; off, modules keep
+        # materializing lazily (the Nop analogue).
+        from ..libs.metrics import metrics_provider
+
+        self.metrics = metrics_provider(cfg.instrumentation)(
+            self.genesis_doc.chain_id)
         self.block_store = BlockStore(_db(cfg, "blockstore",
                                           self.in_memory))
         self.state_store = Store(_db(cfg, "state", self.in_memory))
@@ -395,7 +404,7 @@ class Node(Service):
             from ..libs.debugsrv import DebugServer
 
             dhost, dport = _split_laddr(cfg.rpc.pprof_laddr)
-            self.debug_server = DebugServer(dhost, dport)
+            self.debug_server = DebugServer(dhost, dport, node=self)
             self.pprof_port = await self.debug_server.start()
         self.prometheus_server = None
         if cfg.instrumentation.prometheus:
@@ -403,7 +412,8 @@ class Node(Service):
 
             phost, pport = _split_laddr(
                 cfg.instrumentation.prometheus_listen_addr)
-            self.prometheus_server = DebugServer(phost or "0.0.0.0", pport)
+            self.prometheus_server = DebugServer(phost or "0.0.0.0", pport,
+                                                 node=self)
             self.prometheus_port = await self.prometheus_server.start()
         host, port = _split_laddr(cfg.p2p.laddr)
         await self.transport.listen(host, port)

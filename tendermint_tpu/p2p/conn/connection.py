@@ -15,7 +15,7 @@ import asyncio
 import time
 from dataclasses import dataclass, field
 
-from ...libs import tracing
+from ...libs import flowrate, tracing
 from ...libs.service import Service
 from .secret_connection import DATA_MAX, SEALED_SIZE, SecretConnection
 
@@ -54,17 +54,27 @@ class ChannelStatus:
     send_queue_size: int
     priority: int
     recently_sent: int
+    send_rate: float = 0.0   # flowrate EWMA bytes/s
+    recv_rate: float = 0.0
 
 
 class _Channel:
-    def __init__(self, desc: ChannelDescriptor):
+    def __init__(self, desc: ChannelDescriptor, met):
         self.desc = desc
+        self._met = met
         self.queue: asyncio.Queue[bytes] = asyncio.Queue(
             desc.send_queue_capacity)
         self.sending: bytes | None = None   # message being packetized
         self.sent_pos = 0
         self.recently_sent = 0
         self.recv_buf = bytearray()
+        # bytes accepted by send()/try_send() but not yet fully
+        # packetized — feeds the p2p_pending_send_bytes gauge
+        self.pending_bytes = 0
+        # per-channel EWMA byte-rate monitors (reference: each
+        # MConnection carries flowrate monitors; exposed via status())
+        self.send_monitor = flowrate.Monitor()
+        self.recv_monitor = flowrate.Monitor()
 
     def load_next(self) -> bool:
         if self.sending is None and not self.queue.empty():
@@ -78,6 +88,12 @@ class _Channel:
         self.sent_pos += len(frag)
         eof = self.sent_pos >= len(self.sending)
         if eof:
+            # gauge dec happens HERE, in lockstep with pending_bytes:
+            # decrementing later (after the write) would leak the
+            # message into the gauge forever if the conn dies between
+            # the final fragment being pulled and the write finishing
+            self.pending_bytes -= len(self.sending)
+            self._met.pending_send_bytes.dec(len(self.sending))
             self.sending = None
             self.sent_pos = 0
         return frag, eof
@@ -110,7 +126,10 @@ class MConnection(Service):
         super().__init__(name="MConnection")
         self.conn = conn
         self.config = config or MConnConfig()
-        self.channels = {d.id: _Channel(d) for d in channels}
+        from ...libs.metrics import p2p_metrics
+
+        self._met = p2p_metrics()
+        self.channels = {d.id: _Channel(d, self._met) for d in channels}
         self.on_receive = on_receive
         self.on_error = on_error
         self._send_signal = asyncio.Event()
@@ -128,6 +147,12 @@ class MConnection(Service):
     async def on_stop(self) -> None:
         self._closed.set()
         self.conn.close()
+        # messages that will never finish sending must not inflate the
+        # process-wide pending gauge forever
+        for ch in self.channels.values():
+            if ch.pending_bytes:
+                self._met.pending_send_bytes.dec(ch.pending_bytes)
+                ch.pending_bytes = 0
 
     def _error(self, exc: Exception) -> None:
         if self._errored:
@@ -158,6 +183,8 @@ class MConnection(Service):
                     f.cancel()
         if put not in done or put.cancelled():
             return False
+        ch.pending_bytes += len(msg)
+        self._met.pending_send_bytes.inc(len(msg))
         self._send_signal.set()
         return True
 
@@ -170,6 +197,8 @@ class MConnection(Service):
             ch.queue.put_nowait(msg)
         except asyncio.QueueFull:
             return False
+        ch.pending_bytes += len(msg)
+        self._met.pending_send_bytes.inc(len(msg))
         self._send_signal.set()
         return True
 
@@ -207,6 +236,11 @@ class MConnection(Service):
                 await self._send_bucket.consume(len(pkt))
                 self.conn.write_frame(pkt)
                 ch.recently_sent += len(pkt)
+                ch.send_monitor.update(len(pkt))
+                self._met.peer_send_bytes.inc(len(pkt),
+                                              ch=f"{ch.desc.id:#04x}")
+                if eof:
+                    self._met.message_send.inc(ch=f"{ch.desc.id:#04x}")
                 # Throttled flush (reference flushThrottle): draining per
                 # 1KB packet would serialize a block part into ~1000
                 # scheduler round-trips; drain only every flush interval,
@@ -244,6 +278,9 @@ class MConnection(Service):
                     ch = self.channels.get(chan_id)
                     if ch is None:
                         raise ValueError(f"unknown channel {chan_id:#x}")
+                    ch.recv_monitor.update(len(pkt))
+                    self._met.peer_receive_bytes.inc(
+                        len(pkt), ch=f"{chan_id:#04x}")
                     ch.recv_buf += pkt[5:5 + ln]
                     if len(ch.recv_buf) > ch.desc.recv_message_capacity:
                         raise ValueError(
@@ -251,6 +288,8 @@ class MConnection(Service):
                     if eof:
                         msg = bytes(ch.recv_buf)
                         ch.recv_buf = bytearray()
+                        self._met.message_receive.inc(
+                            ch=f"{chan_id:#04x}")
                         # one span per COMPLETE message (per-packet
                         # spans would dominate the ring under load)
                         with tracing.TRACER.span(tracing.P2P_RECV_MSG,
@@ -286,6 +325,8 @@ class MConnection(Service):
     def status(self) -> list[ChannelStatus]:
         return [
             ChannelStatus(ch.desc.id, ch.queue.qsize(), ch.desc.priority,
-                          ch.recently_sent)
+                          ch.recently_sent,
+                          send_rate=ch.send_monitor.rate,
+                          recv_rate=ch.recv_monitor.rate)
             for ch in self.channels.values()
         ]

@@ -3,21 +3,70 @@
 
 consensus / mempool / query / snapshot each get their own client so a
 slow query can't head-of-line-block consensus. With a LocalClient they
-share one app lock; with sockets they are four connections."""
+share one app lock; with sockets they are four connections.
+
+Every connection's deliver() is wrapped with a latency observer into
+`abci_connection_method_seconds{connection=...,method=...}` — the one
+choke point all client types (local, socket, gRPC) share, mirroring
+the reference's per-method proxy metrics."""
 
 from __future__ import annotations
+
+import time
 
 from ..abci.client import Client, ClientCreator
 from ..libs.service import Service
 
 
+def _snake(req_type_name: str) -> str:
+    """RequestCheckTx -> check_tx."""
+    name = req_type_name.removeprefix("Request")
+    return "".join(
+        ("_" + c.lower()) if c.isupper() and i else c.lower()
+        for i, c in enumerate(name)
+    )
+
+
+def instrument_client(client: Client, conn_name: str) -> Client:
+    """Wrap client.deliver with a per-(connection, method) latency
+    histogram. Works on any Client subclass because `submit` and the
+    typed sugar all funnel through deliver(). The bound-series handle
+    is cached per request TYPE, so the per-call cost on the CheckTx /
+    DeliverTx hot path is a dict lookup + bucket scan — no label
+    sorting per request."""
+    from ..libs.metrics import abci_metrics
+
+    hist = abci_metrics().method_seconds
+    inner = client.deliver
+    bound: dict[type, object] = {}
+
+    async def timed_deliver(req):
+        t = type(req)
+        ob = bound.get(t)
+        if ob is None:
+            bound[t] = ob = hist.labels(
+                connection=conn_name, method=_snake(t.__name__))
+        t0 = time.perf_counter()
+        try:
+            return await inner(req)
+        finally:
+            ob.observe(time.perf_counter() - t0)
+
+    client.deliver = timed_deliver
+    return client
+
+
 class AppConns(Service):
     def __init__(self, creator: ClientCreator):
         super().__init__(name="proxy.AppConns")
-        self.consensus: Client = creator.new_client()
-        self.mempool: Client = creator.new_client()
-        self.query: Client = creator.new_client()
-        self.snapshot: Client = creator.new_client()
+        self.consensus: Client = instrument_client(
+            creator.new_client(), "consensus")
+        self.mempool: Client = instrument_client(
+            creator.new_client(), "mempool")
+        self.query: Client = instrument_client(
+            creator.new_client(), "query")
+        self.snapshot: Client = instrument_client(
+            creator.new_client(), "snapshot")
 
     def _all(self) -> list[Client]:
         return [self.consensus, self.mempool, self.query, self.snapshot]

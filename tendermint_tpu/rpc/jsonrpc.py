@@ -136,7 +136,9 @@ class JSONRPCServer:
                     # on the instrumentation listener; we also serve it
                     # here for one-port deployments).
                     from ..libs.metrics import DEFAULT as METRICS
+                    from ..libs.metrics import node_metrics
 
+                    node_metrics()  # full catalog on every scrape
                     keep = headers.get("connection", "").lower() != "close"
                     text = METRICS.render_text().encode()
                     writer.write(

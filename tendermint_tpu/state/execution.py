@@ -160,6 +160,12 @@ class BlockExecutor:
 
         end_block: abci_t.ResponseEndBlock = abci_responses["end_block"]
         val_updates = validator_updates_from_abci(end_block.validator_updates)
+        from ..libs.metrics import state_metrics
+
+        if val_updates:
+            state_metrics().validator_set_updates.inc(len(val_updates))
+        if end_block.consensus_param_updates:
+            state_metrics().consensus_param_updates.inc()
         new_state = update_state(state, block_id, block, abci_responses,
                                  val_updates)
         if val_updates:

@@ -61,7 +61,7 @@ class StateSyncReactor(Reactor):
     async def sync(self):
         """Discover + restore; returns (state, commit)
         (reference reactor.go:480 Sync via syncer.SyncAny)."""
-        from ..libs.metrics import consensus_metrics
+        from ..libs.metrics import consensus_metrics, statesync_metrics
 
         assert self.syncer is not None, "no state provider wired"
         sw = self.switch
@@ -69,10 +69,12 @@ class StateSyncReactor(Reactor):
             sw.broadcast(SNAPSHOT_CHANNEL,
                          encode_ss_msg(SnapshotsRequestMessage()))
         consensus_metrics().state_syncing.set(1)
+        statesync_metrics().syncing.set(1)
         try:
             return await self.syncer.sync_any()
         finally:
             consensus_metrics().state_syncing.set(0)
+            statesync_metrics().syncing.set(0)
 
     def _request_snapshots(self) -> None:
         sw = self.switch
@@ -113,6 +115,9 @@ class StateSyncReactor(Reactor):
                             chunks=s.chunks, hash=s.hash,
                             metadata=s.metadata)))
             elif isinstance(msg, SnapshotsResponseMessage):
+                from ..libs.metrics import statesync_metrics
+
+                statesync_metrics().snapshots_discovered.inc()
                 if self.syncer is not None:
                     self.syncer.add_snapshot(peer.id, Snapshot(
                         height=msg.height, format=msg.format,
@@ -126,12 +131,18 @@ class StateSyncReactor(Reactor):
                     abci.RequestLoadSnapshotChunk(
                         height=msg.height, format=msg.format,
                         chunk=msg.index))
+                from ..libs.metrics import statesync_metrics
+
+                statesync_metrics().chunks_served.inc()
                 await peer.send(CHUNK_CHANNEL, encode_ss_msg(
                     ChunkResponseMessage(
                         height=msg.height, format=msg.format,
                         index=msg.index, chunk=res.chunk,
                         missing=not res.chunk)))
             elif isinstance(msg, ChunkResponseMessage):
+                from ..libs.metrics import statesync_metrics
+
+                statesync_metrics().chunks_received.inc()
                 if self.syncer is not None:
                     self.syncer.add_chunk(msg, peer.id)
             else:

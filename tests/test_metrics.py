@@ -130,6 +130,227 @@ def test_rpc_metrics_route():
     asyncio.run(run())
 
 
+def test_label_value_escaping():
+    """Backslash, double-quote and newline in label values must be
+    escaped per the exposition format — raw emission produces
+    unparseable output for labels like peer addresses."""
+    reg = Registry()
+    c = reg.counter("conns_total", "Conns.", "test")
+    c.inc(1, addr='tcp://10.0.0.1:26656/"quoted"\\path\nline2')
+    text = reg.render_text()
+    assert ('test_conns_total{addr="tcp://10.0.0.1:26656/'
+            '\\"quoted\\"\\\\path\\nline2"} 1') in text
+    # help text escapes newline/backslash too
+    h = reg.counter("x_total", "line1\nline2\\tail", "test")
+    assert "# HELP test_x_total line1\\nline2\\\\tail" in h.render()[0]
+
+
+def test_labelled_histogram_render_and_cumulative_buckets():
+    reg = Registry()
+    h = reg.histogram("lat", "Latency.", "test", buckets=(0.1, 1.0))
+    h.observe(0.05, conn="consensus")
+    h.observe(0.5, conn="consensus")
+    h.observe(5.0, conn="query")
+    bound = h.labels(conn="consensus")
+    bound.observe(0.07)
+    text = reg.render_text()
+    # cumulative within each labelset, le merged with the labels
+    assert 'test_lat_bucket{conn="consensus",le="0.1"} 2' in text
+    assert 'test_lat_bucket{conn="consensus",le="1"} 3' in text
+    assert 'test_lat_bucket{conn="consensus",le="+Inf"} 3' in text
+    assert 'test_lat_count{conn="consensus"} 3' in text
+    assert 'test_lat_bucket{conn="query",le="0.1"} 0' in text
+    assert 'test_lat_bucket{conn="query",le="+Inf"} 1' in text
+    assert h.count == 4
+    # an unobserved histogram still renders a zero series (family
+    # visibility on first scrape)
+    h2 = reg.histogram("idle", "Idle.", "test", buckets=(1.0,))
+    out = "\n".join(h2.render())
+    assert 'test_idle_bucket{le="+Inf"} 0' in out
+    assert "test_idle_count 0" in out
+
+
+def test_histogram_concurrent_observe_render_consistent():
+    """Executor threads observe while the event loop renders: every
+    rendered snapshot must keep cumulative buckets monotone and
+    +Inf == _count (they derive from one snapshot of the bucket
+    array)."""
+    import re
+    import threading
+
+    reg = Registry()
+    h = reg.histogram("t", "T.", "x", buckets=(0.5,))
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            h.observe(0.1)
+            h.observe(0.9)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            text = reg.render_text()
+            buckets = [int(m) for m in re.findall(
+                r'x_t_bucket{le="[^"]+"} (\d+)', text)]
+            count = int(re.search(r"x_t_count (\d+)", text).group(1))
+            assert buckets == sorted(buckets), "cumulative not monotone"
+            assert buckets[-1] == count, "+Inf bucket != _count"
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+
+
+def test_tracing_metrics_bridge():
+    """A span close on the global TRACER must populate the kind's
+    histogram: dedicated tpu_* stage histograms for the device
+    pipeline, tracing_span_seconds{kind=...} for everything else —
+    with no extra instrumentation call site."""
+    from tendermint_tpu.libs import tracing
+    from tendermint_tpu.libs.metrics import tpu_metrics, tracing_metrics
+
+    tm = tpu_metrics()
+    before_pack = tm.pack_seconds.count
+    with tracing.TRACER.span(tracing.CRYPTO_PACK, lanes=4):
+        pass
+    assert tm.pack_seconds.count == before_pack + 1
+
+    trm = tracing_metrics()
+    sink_hist = trm.span_seconds
+    before = sink_hist.count
+    with tracing.TRACER.span(tracing.WAL_FSYNC):
+        pass
+    assert sink_hist.count == before + 1
+    text = DEFAULT.render_text()
+    assert 'tracing_span_seconds_bucket{kind="wal.fsync",le="+Inf"}' \
+        in text
+
+    # private tracers have no sink: a test Tracer must not feed the
+    # process registry
+    t = tracing.Tracer(capacity=8)
+    before = tm.pack_seconds.count
+    with t.span(tracing.CRYPTO_PACK, lanes=1):
+        pass
+    assert tm.pack_seconds.count == before
+
+
+def test_metrics_and_status_endpoints_end_to_end():
+    """GET /metrics on a DebugServer exposes the full catalog (>= 8
+    namespaces, materialized on scrape) and GET /status returns the
+    machine-readable health verdict."""
+    import json
+
+    from tendermint_tpu.libs.debugsrv import DebugServer
+
+    async def run():
+        srv = DebugServer()
+        port = await srv.start()
+
+        async def get(path):
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+            await w.drain()
+            data = await r.read()
+            w.close()
+            return data
+
+        met = await get("/metrics")
+        head, _, body = met.partition(b"\r\n\r\n")
+        text = body.decode()
+        for ns in ("consensus", "mempool", "p2p", "blockchain",
+                   "statesync", "evidence", "state", "abci", "tpu"):
+            assert f"# TYPE {ns}_" in text, f"namespace {ns} missing"
+
+        raw = await get("/status")
+        head, _, body = raw.partition(b"\r\n\r\n")
+        assert b"application/json" in head
+        doc = json.loads(body)
+        assert doc["status"] in ("ok", "degraded", "failing")
+        for check in ("consensus", "p2p", "mempool", "device"):
+            assert doc["checks"][check]["status"] in (
+                "ok", "degraded", "failing")
+        # no node attached, nothing committed: consensus can't be "ok"
+        assert doc["checks"]["consensus"]["height"] == \
+            int(consensus_metrics().height.value())
+        srv.close()
+
+    asyncio.run(run())
+
+
+def test_abci_proxy_method_latency():
+    """AppConns wraps every connection's deliver() with the
+    per-(connection, method) latency histogram."""
+    from tendermint_tpu.abci import types as abci_t
+    from tendermint_tpu.abci.client import ClientCreator
+    from tendermint_tpu.abci.kvstore import KVStoreApp
+    from tendermint_tpu.libs.metrics import abci_metrics
+    from tendermint_tpu.proxy import AppConns
+
+    hist = abci_metrics().method_seconds
+
+    async def run():
+        conns = AppConns(ClientCreator(app=KVStoreApp()))
+        await conns.start()
+        try:
+            await conns.query.echo("hi")
+            await conns.mempool.check_tx(
+                abci_t.RequestCheckTx(tx=b"k=v"))
+        finally:
+            await conns.stop()
+
+    q_bound = hist.labels(connection="query", method="echo")
+    m_bound = hist.labels(connection="mempool", method="check_tx")
+    q0 = sum(q_bound._series.counts)
+    m0 = sum(m_bound._series.counts)
+    asyncio.run(run())
+    assert sum(q_bound._series.counts) == q0 + 1
+    assert sum(m_bound._series.counts) == m0 + 1
+    text = DEFAULT.render_text()
+    assert ('abci_connection_method_seconds_bucket{connection="query",'
+            'le="+Inf",method="echo"}') in text
+
+
+def test_check_metrics_lint_and_docs_sync():
+    from tools.check_metrics import collect_problems
+
+    assert collect_problems() == []
+
+
+def test_metrics_snapshot_delta():
+    from tendermint_tpu.libs import metrics as M
+
+    reg = Registry()
+    c = reg.counter("ops_total", "Ops.", "test")
+    h = reg.histogram("lat", "Lat.", "test", buckets=(0.1, 1.0, 10.0))
+    c.inc(3, kind="a")
+    h.observe(0.05)
+    before = M.snapshot(reg)
+    c.inc(2, kind="a")
+    c.inc(1, kind="b")
+    h.observe(0.5)
+    h.observe(0.6)
+    d = M.delta(before, M.snapshot(reg))
+    assert d['test_ops_total{kind="a"}'] == 2
+    assert d['test_ops_total{kind="b"}'] == 1
+    hd = d["test_lat"]
+    assert hd["count"] == 2
+    assert abs(hd["sum"] - 1.1) < 1e-6
+    assert 0.1 <= hd["p50"] <= 1.0  # both new observes in (0.1, 1.0]
+
+
+def test_node_metrics_provider_gating():
+    from tendermint_tpu.config import InstrumentationConfig
+    from tendermint_tpu.libs.metrics import NodeMetrics, metrics_provider
+
+    on = metrics_provider(InstrumentationConfig(prometheus=True))
+    off = metrics_provider(InstrumentationConfig(prometheus=False))
+    assert isinstance(on("chain-a"), NodeMetrics)
+    assert off("chain-a") is None
+
+
 def test_reference_catalog_metrics_present():
     """Every metric in the reference's docs/nodes/metrics.md catalog
     has an equivalent in our registries (naming: <ns>_<name>)."""

@@ -89,8 +89,12 @@ def find_ad_hoc_spans(root: str = PKG) -> list[str]:
 def measure_overhead(n: int = 20000) -> tuple[float, float]:
     """(enabled_s_per_span, disabled_s_per_span) for an enter/exit of
     an attribute-carrying span on a private tracer. Best-of-3 batches:
-    the budget polices the span path, not the box's scheduler."""
-    from tendermint_tpu.libs import tracing
+    the budget polices the span path, not the box's scheduler.
+
+    The enabled tracer carries the REAL tracing→metrics bridge sink
+    (libs/metrics.py span_metrics_sink), so the budget covers the full
+    production span close: ring append + histogram observe."""
+    from tendermint_tpu.libs import metrics, tracing
 
     kind = tracing.CRYPTO_PACK  # a real registered hot-path kind
 
@@ -104,7 +108,9 @@ def measure_overhead(n: int = 20000) -> tuple[float, float]:
             best = min(best, (time.perf_counter() - t0) / n)
         return best
 
-    enabled = run(tracing.Tracer(capacity=4096, enabled=True))
+    bridged = tracing.Tracer(capacity=4096, enabled=True)
+    bridged.set_metrics_sink(metrics.span_metrics_sink)
+    enabled = run(bridged)
     disabled = run(tracing.Tracer(capacity=4096, enabled=False))
     return enabled, disabled
 
