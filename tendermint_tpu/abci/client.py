@@ -128,7 +128,19 @@ async def read_frame(reader: asyncio.StreamReader):
 
 class SocketClient(Client):
     """Pipelined socket client. Responses arrive strictly in request
-    order, so a FIFO of futures pairs them back up."""
+    order, so a FIFO of futures pairs them back up.
+
+    A lost connection no longer kills the client for good: in-flight
+    requests fail fast (they may or may not have been executed — the
+    caller's replay/handshake logic owns that ambiguity, so NOTHING is
+    silently retried here), and a background task re-dials the app
+    with capped jittered exponential backoff. Once the transport is
+    back, new requests flow again — a restarted ABCI app server no
+    longer requires restarting the node (reference behavior was to
+    die with the connection)."""
+
+    RECONNECT_BASE_S = 0.5
+    RECONNECT_MAX_S = 15.0
 
     def __init__(self, host: str = "127.0.0.1", port: int = 26658,
                  unix_path: str | None = None):
@@ -139,7 +151,7 @@ class SocketClient(Client):
         self._pending: asyncio.Queue[asyncio.Future] = asyncio.Queue()
         self._conn_err: Exception | None = None
 
-    async def on_start(self) -> None:
+    async def _connect(self) -> None:
         if self.unix_path:
             self._reader, self._writer = await asyncio.open_unix_connection(
                 self.unix_path
@@ -148,6 +160,9 @@ class SocketClient(Client):
             self._reader, self._writer = await asyncio.open_connection(
                 self.host, self.port
             )
+
+    async def on_start(self) -> None:
+        await self._connect()
         self.spawn(self._recv_loop(), name="abci-recv")
 
     async def on_stop(self) -> None:
@@ -172,6 +187,46 @@ class SocketClient(Client):
                 fut = self._pending.get_nowait()
                 if not fut.done():
                     fut.set_exception(ABCIClientError(f"connection lost: {e}"))
+            if self.is_running:
+                self.spawn(self._reconnect_loop(), name="abci-reconnect")
+
+    async def _reconnect_loop(self) -> None:
+        import logging
+
+        from ..libs.metrics import abci_metrics
+        from ..libs.net import jittered_backoff
+
+        log = logging.getLogger("abci.client")
+        attempt = 0
+        while self.is_running:
+            await asyncio.sleep(jittered_backoff(
+                attempt, self.RECONNECT_BASE_S, self.RECONNECT_MAX_S))
+            if not self.is_running:
+                return
+            attempt += 1
+            try:
+                if self._writer is not None:
+                    self._writer.close()
+                await self._connect()
+            except (ConnectionError, OSError) as e:
+                abci_metrics().client_reconnects.inc(result="failed")
+                log.warning("ABCI app reconnect attempt %d failed: %s",
+                            attempt, e)
+                continue
+            # any future that raced into the queue after the recv
+            # loop's drain must not mispair with responses on the NEW
+            # connection (the FIFO would be off by one forever)
+            while not self._pending.empty():
+                fut = self._pending.get_nowait()
+                if not fut.done():
+                    fut.set_exception(ABCIClientError(
+                        "connection replaced during reconnect"))
+            self._conn_err = None
+            abci_metrics().client_reconnects.inc(result="ok")
+            log.warning("ABCI app connection re-established after "
+                        "%d attempts", attempt)
+            self.spawn(self._recv_loop(), name="abci-recv")
+            return
 
     async def deliver(self, req):
         if self._conn_err is not None:

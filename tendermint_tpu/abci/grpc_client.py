@@ -17,11 +17,43 @@ from .grpc_server import METHOD_BY_TYPE, SERVICE_NAME
 
 
 class GRPCClient(Client):
+    """gRPC channels reconnect transparently (built-in backoff), so a
+    restarted app server is usually picked up without help. The one
+    hole: a channel that has collapsed into a terminal/broken state
+    keeps failing every RPC with UNAVAILABLE. After a few consecutive
+    UNAVAILABLEs the channel is torn down and recreated so the client
+    recovers instead of dying with the app connection (each failed RPC
+    still fails fast — nothing is silently retried)."""
+
+    RECREATE_AFTER_UNAVAILABLE = 3
+
     def __init__(self, host: str = "127.0.0.1", port: int = 26658):
         super().__init__(name="abci.GRPCClient")
         self.host, self.port = host, port
         self._channel: aio.Channel | None = None
         self._stubs: dict[str, object] = {}
+        self._unavailable_streak = 0
+
+    async def _recreate_channel(self) -> None:
+        from ..libs.metrics import abci_metrics
+
+        # Swap atomically BEFORE any await: pipelined delivers run
+        # concurrently, and a window where _channel is None would turn
+        # their failures into bare AssertionErrors (which consensus
+        # does not handle) instead of ABCIClientError. Resetting the
+        # streak in the same synchronous block also keeps a second
+        # concurrent UNAVAILABLE from recreating (and closing) the
+        # fresh channel out from under callers already using it.
+        old = self._channel
+        self._channel = aio.insecure_channel(f"{self.host}:{self.port}")
+        self._stubs.clear()
+        self._unavailable_streak = 0
+        abci_metrics().client_reconnects.inc(result="grpc_recreate")
+        if old is not None:
+            try:
+                await old.close()
+            except Exception:
+                pass
 
     async def on_start(self) -> None:
         self._channel = aio.insecure_channel(f"{self.host}:{self.port}")
@@ -47,8 +79,15 @@ class GRPCClient(Client):
         if method is None:
             raise ABCIClientError(f"unknown request {type(req).__name__}")
         try:
-            return await self._stub(method)(req)
+            resp = await self._stub(method)(req)
+            self._unavailable_streak = 0
+            return resp
         except aio.AioRpcError as e:
+            if e.code() == grpc.StatusCode.UNAVAILABLE and self.is_running:
+                self._unavailable_streak += 1
+                if self._unavailable_streak >= \
+                        self.RECREATE_AFTER_UNAVAILABLE:
+                    await self._recreate_channel()
             raise ABCIClientError(
                 f"{method}: {e.code().name}: {e.details()}") from e
 

@@ -210,6 +210,29 @@ class InstrumentationConfig:
 
 
 @dataclass
+class ChaosConfig:
+    """Chaos engineering (this framework's addition; no reference
+    equivalent). `failpoints` is a libs/failpoints.py spec string —
+    e.g. "wal.fsync=delay:50;every=10,device.verify=error;prob=0.01"
+    — armed at node build time. Config is the STRICT surface: a
+    malformed spec fails validate_basic instead of being skipped
+    (unlike the TM_TPU_FAILPOINTS env var, which logs and ignores)."""
+
+    failpoints: str = ""
+
+    def validate_basic(self) -> None:
+        if self.failpoints:
+            from .libs.failpoints import validate_spec
+
+            # the SAME checks install_spec/arm() enforce (dry run):
+            # anything that would raise at node build must raise here
+            try:
+                validate_spec(self.failpoints)
+            except ValueError as e:
+                raise ValueError(f"[chaos] failpoints: {e}") from None
+
+
+@dataclass
 class TxIndexConfig:
     """reference: config/config.go:976 TxIndexConfig — which indexer
     backs /tx_search and /block_search: "kv" (default) or "null"
@@ -235,6 +258,7 @@ class Config:
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig
     )
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
 
     def validate_basic(self) -> None:
         self.rpc.validate_basic()
@@ -244,6 +268,7 @@ class Config:
         self.fastsync.validate_basic()
         self.consensus.validate_basic()
         self.tx_index.validate_basic()
+        self.chaos.validate_basic()
 
     # -- file round trip (flat TOML-ish key=value per [section]) --
 
@@ -253,7 +278,7 @@ class Config:
         lines = []
         for section_name in ("base", "rpc", "p2p", "mempool", "statesync",
                              "fastsync", "consensus", "tx_index",
-                             "instrumentation"):
+                             "instrumentation", "chaos"):
             section = getattr(self, section_name)
             lines.append(f"[{section_name}]")
             for f in dataclasses.fields(section):

@@ -21,7 +21,7 @@ from dataclasses import dataclass
 
 from ..config import ConsensusConfig
 from ..libs import tracing
-from ..libs.fail import fail
+from ..libs.failpoints import hit as _failpoint
 from ..libs.service import Service
 from ..mempool import Mempool, NopMempool
 from ..state import State as SmState
@@ -697,12 +697,12 @@ class ConsensusState(Service):
             seen_commit = precommits.make_commit()
             self.block_store.save_block(block, parts, seen_commit)
 
-        fail()  # crash-point: block saved, WAL end-height not yet written
+        _failpoint("consensus.commit.block_saved")
 
         if self.wal is not None and not self._replay_mode:
             self.wal.write_sync(EndHeightMessage(height), _time.time_ns())
 
-        fail()  # crash-point: WAL delimited, state not yet applied
+        _failpoint("consensus.commit.wal_delimited")
 
         state_copy = self.state.copy()
         new_state, retain_height = await self.block_exec.apply_block(

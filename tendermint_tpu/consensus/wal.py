@@ -18,7 +18,7 @@ from dataclasses import dataclass
 logger = logging.getLogger("wal")
 
 from ..encoding.proto import Reader, Writer
-from ..libs import tracing
+from ..libs import failpoints, tracing
 
 MAX_MSG_SIZE = 1 << 20  # 1MB, reference wal.go maxMsgSizeBytes
 
@@ -243,6 +243,9 @@ class WAL:
         if len(data) > MAX_MSG_SIZE:
             raise ValueError(f"WAL message too big: {len(data)}")
         frame = _FRAME.pack(zlib.crc32(data), len(data)) + data
+        # chaos: `corrupt` writes a bit-flipped/truncated frame — the
+        # torn-write shape repair() must quarantine on the next boot
+        frame = failpoints.hit("wal.torn_write", payload=frame)
         self._f.write(frame)
         self._head_size += len(frame)
         if self._head_size >= self.head_size_limit:
@@ -254,6 +257,7 @@ class WAL:
             self.flush_and_sync()
 
     def flush_and_sync(self) -> None:
+        failpoints.hit("wal.fsync")
         self._f.flush()
         os.fsync(self._f.fileno())
 
@@ -394,19 +398,45 @@ class WAL:
         return tail, True
 
     def repair(self) -> bool:
-        """Truncate a corrupted tail of the HEAD segment in place,
-        keeping every valid record (reference: consensus/state.go:2217
-        repairWalFile — crashes only ever tear the file being
-        appended). Returns True if anything was cut. The cut point is
-        the decoder's consumed-bytes offset — the exact on-disk
-        boundary, independent of whether re-encoding would be
-        byte-identical."""
+        """Cut a corrupted tail off the HEAD segment, keeping every
+        valid record (reference: consensus/state.go:2217 repairWalFile
+        — crashes only ever tear the file being appended). Returns
+        True if anything was cut. The cut point is the decoder's
+        consumed-bytes offset — the exact on-disk boundary,
+        independent of whether re-encoding would be byte-identical.
+
+        The tail is QUARANTINED, not deleted: the bytes move to
+        `<path>.corrupt.NNN` before the truncate, so a repair that cut
+        more than a crash tail (bad disk, injected mid-record torn
+        write) leaves the evidence on disk for post-mortem instead of
+        silently destroying it."""
         _, consumed, size = self._decode_file(self.path)
         if size <= consumed:
             return False
         self._f.close()
+        with open(self.path, "rb") as f:
+            f.seek(consumed)
+            tail = f.read()
+        qpath = self._quarantine_path()
+        with open(qpath, "wb") as qf:
+            qf.write(tail)
+            qf.flush()
+            os.fsync(qf.fileno())
         with open(self.path, "r+b") as f:
             f.truncate(consumed)
+        logger.warning(
+            "WAL repair: quarantined %d corrupt tail bytes of %s "
+            "to %s", len(tail), self.path, qpath)
         self._f = open(self.path, "ab")
         self._head_size = consumed
         return True
+
+    def _quarantine_path(self) -> str:
+        """First free `<path>.corrupt.NNN` — repeated repairs (chaos
+        sweeps, flaky disks) must not overwrite earlier evidence."""
+        n = 0
+        while True:
+            p = f"{self.path}.corrupt.{n:03d}"
+            if not os.path.exists(p):
+                return p
+            n += 1

@@ -17,7 +17,10 @@ not worth it under ``_DEVICE_THRESHOLD`` signatures.
 
 from __future__ import annotations
 
+import functools
+import hashlib
 import logging
+import threading
 import time
 
 import numpy as np
@@ -45,22 +48,204 @@ _DEVICE_THRESHOLD_SR = 4
 _CPU_JIT_THRESHOLD_SR = 16
 
 # Device-failure degradation: a kernel launch raising (wedged relay,
-# OOM, backend death) marks the device down for a cooldown; every
-# caller transparently gets host verdicts — identical semantics, just
-# slower — instead of an exception on a consensus-critical path. The
-# device is retried after the cooldown so a recovered backend is
-# picked back up without a restart.
-DEVICE_RETRY_COOLDOWN_S = 30.0
-_device_down_until = 0.0
+# OOM, backend death, NaN verdicts) opens a per-backend CIRCUIT
+# BREAKER; every caller transparently gets host verdicts — identical
+# semantics, just slower — instead of an exception on a consensus-
+# critical path. Unlike the old flat 30 s cooldown (which retried by
+# burning a full PRODUCTION batch every window), recovery is probed
+# with a small SYNTHETIC batch: when the cooldown expires the breaker
+# goes half-open and the next would-be device caller runs a
+# PROBE_LANES-sized known-answer batch first — a still-dead device
+# costs one probe per window and a production commit batch never hits
+# an open breaker. Cooldowns grow exponentially with jitter so a
+# persistently broken backend backs off instead of probing in
+# lockstep across the fleet.
+BREAKER_BASE_COOLDOWN_S = 2.0
+BREAKER_MAX_COOLDOWN_S = 300.0
+PROBE_LANES = 8                 # synthetic lanes per half-open probe
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+_STATE_CODE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
 
 
-def device_available() -> bool:
-    return time.monotonic() >= _device_down_until
+class CircuitBreaker:
+    """closed -> (launch raised) -> open -> (cooldown expired, next
+    acquire) -> half-open probe -> closed on success, open again (with
+    a doubled cooldown) on failure. Thread-safe: BatchVerifier runs in
+    executor threads; only one caller probes at a time and concurrent
+    acquirers during a probe take the host path instead of blocking."""
+
+    def __init__(self, backend: str, probe):
+        self.backend = backend
+        self._probe = probe  # () -> bool: synthetic batch round trip
+        self._lock = threading.Lock()
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self._open_until = 0.0
+        self._probing = False
+
+    # -- reads --
+
+    def available(self) -> bool:
+        """Pure read: True iff closed (health checks, expanded-path
+        gating). Never probes."""
+        return self.state == CLOSED
+
+    def cooldown_remaining(self) -> float:
+        if self.state == CLOSED:
+            return 0.0
+        return max(0.0, self._open_until - time.monotonic())
+
+    # -- transitions --
+
+    def _set_state(self, state: str) -> None:
+        self.state = state
+        try:
+            from ..libs.metrics import crypto_metrics
+
+            crypto_metrics().breaker_state.set(
+                _STATE_CODE[state], backend=self.backend)
+        except Exception:  # pragma: no cover - metrics never fatal
+            pass
+
+    def _open_locked(self) -> None:
+        from ..libs.net import jittered_backoff
+
+        cd = jittered_backoff(max(self.consecutive_failures - 1, 0),
+                              BREAKER_BASE_COOLDOWN_S,
+                              BREAKER_MAX_COOLDOWN_S)
+        self._open_until = time.monotonic() + cd
+        self._set_state(OPEN)
+        from ..libs.metrics import crypto_metrics
+
+        crypto_metrics().breaker_opens.inc(backend=self.backend)
+        logger.warning(
+            "device breaker OPEN (%s): failure #%d, cooldown %.1fs",
+            self.backend, self.consecutive_failures, cd)
+
+    def record_failure(self) -> None:
+        """A production (or probe) launch raised on this backend."""
+        with self._lock:
+            self.consecutive_failures += 1
+            self._open_locked()
+
+    def acquire(self) -> bool:
+        """Called by verify paths before launching on device. Closed:
+        go ahead. Open and cooling down: host path. Open and expired:
+        half-open — run the synthetic probe inline (bounded, probe-
+        sized); success closes the breaker and admits the caller."""
+        with self._lock:
+            if self.state == CLOSED:
+                return True
+            if self._probing or time.monotonic() < self._open_until:
+                return False
+            self._probing = True
+            self._set_state(HALF_OPEN)
+        ok = False
+        try:
+            ok = bool(self._probe())
+        except Exception:
+            logger.exception("half-open probe raised (%s)", self.backend)
+            ok = False
+        from ..libs.metrics import crypto_metrics
+
+        crypto_metrics().breaker_probes.inc(
+            backend=self.backend, result="ok" if ok else "failed")
+        with self._lock:
+            self._probing = False
+            if ok:
+                self.consecutive_failures = 0
+                self._set_state(CLOSED)
+                logger.warning(
+                    "device breaker CLOSED (%s): probe succeeded",
+                    self.backend)
+            else:
+                self.consecutive_failures += 1
+                self._open_locked()
+        return ok
+
+    def reset(self) -> None:
+        with self._lock:
+            self.consecutive_failures = 0
+            self._open_until = 0.0
+            self._probing = False
+            self._set_state(CLOSED)
 
 
-def mark_device_failed() -> None:
-    global _device_down_until
-    _device_down_until = time.monotonic() + DEVICE_RETRY_COOLDOWN_S
+@functools.cache
+def _ed_probe_triple() -> tuple[bytes, bytes, bytes]:
+    from . import ed25519_ref as edr
+
+    seed = hashlib.sha256(b"tendermint_tpu ed25519 breaker probe").digest()
+    msg = b"breaker probe"
+    return edr.public_key_from_seed(seed), msg, edr.sign(seed, msg)
+
+
+def _probe_ed25519() -> bool:
+    from ..libs import failpoints
+    from .tpu import verify as tpu_verify
+
+    failpoints.hit("device.verify")
+    p, m, s = _ed_probe_triple()
+    out = tpu_verify.verify_batch([p] * PROBE_LANES, [m] * PROBE_LANES,
+                                  [s] * PROBE_LANES)
+    # a NaN-ing kernel returns wrong verdicts without raising — a
+    # known-answer mismatch is a failed probe, not a closed breaker
+    return bool(np.asarray(out).all())
+
+
+@functools.cache
+def _sr_probe_triple() -> tuple[bytes, bytes, bytes]:
+    from . import sr25519_ref as srr
+
+    mini = hashlib.sha256(b"tendermint_tpu sr25519 breaker probe").digest()
+    msg = b"breaker probe"
+    return srr.public_key_from_mini(mini), msg, srr.sign(mini, msg)
+
+
+def _probe_sr25519() -> bool:
+    from ..libs import failpoints
+    from .tpu import sr_verify
+
+    failpoints.hit("device.verify")
+    p, m, s = _sr_probe_triple()
+    out = sr_verify.verify_batch_sr([p] * PROBE_LANES, [m] * PROBE_LANES,
+                                    [s] * PROBE_LANES)
+    return bool(np.asarray(out).all())
+
+
+_BREAKERS: dict[str, CircuitBreaker] = {
+    "ed25519": CircuitBreaker("ed25519", _probe_ed25519),
+    "sr25519": CircuitBreaker("sr25519", _probe_sr25519),
+}
+
+
+def breaker(backend: str = "ed25519") -> CircuitBreaker:
+    return _BREAKERS[backend]
+
+
+def breaker_states() -> dict[str, str]:
+    """{backend: state} — the /status device check detail."""
+    return {name: b.state for name, b in _BREAKERS.items()}
+
+
+def reset_breakers() -> None:
+    """Test hook: force every backend breaker closed."""
+    for b in _BREAKERS.values():
+        b.reset()
+
+
+def device_available(backend: str | None = None) -> bool:
+    """Pure read (never probes): is the backend's breaker closed? With
+    no backend, True only when EVERY breaker is closed (the legacy
+    any-cooldown-engaged reading)."""
+    if backend is not None:
+        return _BREAKERS[backend].available()
+    return all(b.available() for b in _BREAKERS.values())
+
+
+def mark_device_failed(backend: str = "ed25519") -> None:
+    _BREAKERS[backend].record_failure()
     from ..libs.metrics import crypto_metrics
 
     crypto_metrics().device_failures.inc()
@@ -117,10 +302,12 @@ class BatchVerifier:
             use_dev = self._use_device
             if use_dev is None:
                 use_dev = len(items) >= _DEVICE_THRESHOLD
-            if use_dev and device_available():
+            if use_dev and breaker("ed25519").acquire():
                 try:
+                    from ..libs import failpoints
                     from .tpu import verify as tpu_verify
 
+                    failpoints.hit("device.verify")
                     met.device_launches.inc()
                     out = tpu_verify.verify_batch(
                         [pk.bytes() for pk, _, _ in items],
@@ -130,11 +317,12 @@ class BatchVerifier:
                     met.batch_lanes.inc(len(items), backend="tpu")
                     return out
                 except Exception:
-                    mark_device_failed()
+                    mark_device_failed("ed25519")
                     logger.exception(
                         "device ed25519 batch failed (%d lanes); "
-                        "degrading to host for %.0fs",
-                        len(items), DEVICE_RETRY_COOLDOWN_S)
+                        "breaker open %.1fs, degrading to host",
+                        len(items),
+                        breaker("ed25519").cooldown_remaining())
             if use_dev:
                 # device wanted (threshold met) but unavailable/failed
                 from ..libs.metrics import tpu_metrics
@@ -157,10 +345,12 @@ class BatchVerifier:
             use_dev = self._use_device
             if use_dev is None:
                 use_dev = len(items) >= _DEVICE_THRESHOLD_SR
-            if use_dev and device_available():
+            if use_dev and breaker("sr25519").acquire():
                 try:
+                    from ..libs import failpoints
                     from .tpu import sr_verify
 
+                    failpoints.hit("device.verify")
                     met.device_launches.inc()
                     out = sr_verify.verify_batch_sr(
                         [pk.bytes() for pk, _, _ in items],
@@ -171,11 +361,12 @@ class BatchVerifier:
                                         backend="tpu-sr25519")
                     return out
                 except Exception:
-                    mark_device_failed()
+                    mark_device_failed("sr25519")
                     logger.exception(
                         "device sr25519 batch failed (%d lanes); "
-                        "degrading to host for %.0fs",
-                        len(items), DEVICE_RETRY_COOLDOWN_S)
+                        "breaker open %.1fs, degrading to host",
+                        len(items),
+                        breaker("sr25519").cooldown_remaining())
             if use_dev:
                 from ..libs.metrics import tpu_metrics
 

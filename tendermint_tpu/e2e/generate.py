@@ -62,13 +62,26 @@ def generate(rng: random.Random) -> Manifest:
     # survive without a quorum of helpers.
     perturbable = nodes - (1 if late_statesync else 0)
     ops = PERTURB_OPS if nodes >= 3 else ("kill", "restart")
+    # degrade-don't-kill failpoint rotation for sampled `chaos` ops
+    # (docs/CHAOS.md): shapes every node must ride out under load
+    chaos_choices = (
+        ("wal.fsync", "delay"), ("db.set", "delay"),
+        ("abci.deliver", "delay"), ("device.verify", "error"),
+    )
     for i in range(perturbable):
         if rng.random() < 0.35:
+            op = rng.choice(ops)
+            kwargs = {}
+            if op == "chaos":
+                fpname, action = rng.choice(chaos_choices)
+                kwargs = {"failpoint": fpname, "action": action,
+                          "delay_ms": rng.choice((10, 25, 50))}
             m.perturbations.append(Perturbation(
                 node=i,
-                op=rng.choice(ops),
+                op=op,
                 at_height=rng.randint(2, max(2, wait_height - 2)),
                 duration=round(rng.uniform(1.0, 4.0), 1),
+                **kwargs,
             ))
 
     # Validator-power schedule: builtin app only (external abci-cli
@@ -131,6 +144,10 @@ def to_toml(m: Manifest) -> str:
         out += ["", "[[perturbations]]", f"node = {p.node}",
                 f'op = "{p.op}"', f"at_height = {p.at_height}",
                 f"duration = {p.duration}"]
+        if p.op == "chaos":
+            out += [f'failpoint = "{p.failpoint}"',
+                    f'action = "{p.action}"',
+                    f"delay_ms = {p.delay_ms}"]
     for vu in m.validator_updates:
         out += ["", "[[validator_updates]]", f"node = {vu.node}",
                 f"at_height = {vu.at_height}", f"power = {vu.power}"]

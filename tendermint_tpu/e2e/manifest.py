@@ -11,9 +11,12 @@ TOML schema:
     [[perturbations]]
     node = 1                     # node index
     op = "kill"                  # kill | pause | disconnect |
-                                 #   disconnect_hard | restart
+                                 #   disconnect_hard | restart | chaos
     at_height = 3                # trigger when the net reaches this
-    duration = 3.0               # pause/disconnect/sever length (s)
+    duration = 3.0               # pause/disconnect/sever/chaos len (s)
+    failpoint = "wal.fsync"      # chaos only: named failpoint
+    action = "delay"             # chaos only: error | delay | corrupt
+    delay_ms = 25                # chaos only: delay action stall
 
     [[validator_updates]]        # scheduled valset change
     node = 3                     # whose power to change
@@ -27,8 +30,11 @@ from dataclasses import dataclass, field
 
 # disconnect = long SIGSTOP (peers observe a stall); disconnect_hard =
 # TCP severance via the switch's sever() hook (peers observe connection
-# RESETS and must re-dial — reference perturb.go severs the docker net)
-OPS = ("kill", "pause", "disconnect", "disconnect_hard", "restart")
+# RESETS and must re-dial — reference perturb.go severs the docker net);
+# chaos = arm a named failpoint (libs/failpoints.py) on the node via
+# its POST /debug/failpoint endpoint for `duration` seconds
+OPS = ("kill", "pause", "disconnect", "disconnect_hard", "restart",
+       "chaos")
 
 
 @dataclass
@@ -37,6 +43,10 @@ class Perturbation:
     op: str
     at_height: int
     duration: float = 3.0
+    # chaos op only: which failpoint, what shape, how slow
+    failpoint: str = ""
+    action: str = "delay"
+    delay_ms: float = 25.0
 
     def validate(self, n_nodes: int) -> None:
         if self.op not in OPS:
@@ -49,6 +59,19 @@ class Perturbation:
             # same bound the unsafe_net_sever RPC enforces — reject at
             # manifest load, not mid-run
             raise ValueError("disconnect_hard duration must be in (0, 60]")
+        if self.op == "chaos":
+            from ..libs.failpoints import ACTIONS, BY_NAME
+
+            if self.failpoint not in BY_NAME:
+                raise ValueError(
+                    f"unknown chaos failpoint {self.failpoint!r}")
+            if self.action not in ACTIONS or self.action == "crash":
+                # a crash mid-run is the `kill` op's job (the runner
+                # restarts those); an uncoordinated crash would just
+                # fail the run
+                raise ValueError(
+                    f"chaos action must be error|delay|corrupt, "
+                    f"not {self.action!r}")
 
 
 @dataclass
@@ -198,7 +221,8 @@ class Manifest:
                        "perturbations", "misbehaviors",
                        "validator_updates", "late_statesync_node",
                        "abci", "privval", "seed_bootstrap"})
-    _PERTURB_KEYS = frozenset({"node", "op", "at_height", "duration"})
+    _PERTURB_KEYS = frozenset({"node", "op", "at_height", "duration",
+                               "failpoint", "action", "delay_ms"})
     _MISBEHAVIOR_KEYS = frozenset({"node", "spec"})
     _VALUPDATE_KEYS = frozenset({"node", "at_height", "power"})
 
@@ -236,6 +260,9 @@ class Manifest:
                     op=p["op"],
                     at_height=int(p["at_height"]),
                     duration=float(p.get("duration", 3.0)),
+                    failpoint=p.get("failpoint", ""),
+                    action=p.get("action", "delay"),
+                    delay_ms=float(p.get("delay_ms", 25.0)),
                 )
                 for p in d.get("perturbations", [])
             ],

@@ -8,7 +8,9 @@ Stages, mirroring the reference runner:
   perturb  -> at scheduled heights: kill -9 (+restart with WAL
               recovery), SIGSTOP pause, long-SIGSTOP "disconnect"
               (peers drop the frozen node; it must re-dial on wake),
-              graceful restart (perturb.go:12-60)
+              graceful restart (perturb.go:12-60), and "chaos" —
+              arming a named failpoint (libs/failpoints.py) on a
+              node via POST /debug/failpoint for a window
   test     -> every node reaches wait_height; all block hashes agree
               (no fork); perturbed nodes caught back up
   cleanup  -> SIGTERM all, SIGKILL stragglers
@@ -148,11 +150,12 @@ class SignerProc:
 
 class NodeProc:
     def __init__(self, index: int, home: str, rpc_port: int,
-                 misbehavior: str = ""):
+                 misbehavior: str = "", pprof_port: int = 0):
         self.index = index
         self.home = home
         self.rpc_port = rpc_port
         self.misbehavior = misbehavior
+        self.pprof_port = pprof_port  # chaos/debug endpoint (0 = off)
         self.proc: subprocess.Popen | None = None
         self.log_path = os.path.join(home, "node.log")
         self._log_f = None
@@ -256,6 +259,12 @@ class Runner:
             if any(p.op == "disconnect_hard"
                    for p in self.m.perturbations):
                 cfg.rpc.unsafe = True  # exposes unsafe_net_sever
+            pprof_port = 0
+            if any(p.op == "chaos" for p in self.m.perturbations):
+                # chaos perturbations drive the node's debug endpoint
+                # (POST /debug/failpoint) — give every node one
+                pprof_port = self.base_port + 4000 + i
+                cfg.rpc.pprof_laddr = f"tcp://127.0.0.1:{pprof_port}"
             if seed_str is not None:
                 # the ONLY configured contact is the seed: the mesh
                 # must form via PEX address-book discovery (fast
@@ -298,7 +307,8 @@ class Runner:
             mb = ",".join(m.spec for m in self.m.misbehaviors
                           if m.node == i)
             self.nodes.append(NodeProc(
-                i, home, self.base_port + 1000 + i, misbehavior=mb))
+                i, home, self.base_port + 1000 + i, misbehavior=mb,
+                pprof_port=pprof_port))
 
     def _make_seed_home(self) -> str:
         """Create a dedicated NON-validator seed node (reference e2e
@@ -405,6 +415,26 @@ class Runner:
         cli = HTTPClient("127.0.0.1", node.rpc_port, timeout=5)
         return await cli.call(method, **params)
 
+    async def _debug_post(self, node: NodeProc, path: str,
+                          payload: dict) -> dict:
+        """POST JSON to the node's debug server (tiny HTTP/1.0)."""
+        import json
+
+        assert node.pprof_port, "node has no debug endpoint configured"
+        body = json.dumps(payload).encode()
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", node.pprof_port)
+        try:
+            writer.write(
+                f"POST {path} HTTP/1.0\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+            await writer.drain()
+            raw = await asyncio.wait_for(reader.read(), timeout=10)
+        finally:
+            writer.close()
+        head, _, resp_body = raw.partition(b"\r\n\r\n")
+        return json.loads(resp_body)
+
     async def height_of(self, node: NodeProc) -> int:
         st = await self._rpc(node, "status")
         return int(st["sync_info"]["latest_block_height"])
@@ -509,6 +539,20 @@ class Runner:
             self.log(f"perturb: node{p.node} dropped "
                      f"{res['connections_dropped']} conns")
             await asyncio.sleep(p.duration)
+        elif p.op == "chaos":
+            # arm a named failpoint through the node's debug endpoint
+            # for the window, then disarm — the net must degrade and
+            # recover, never wedge (the final wait_all_height is the
+            # recovery assertion)
+            spec: dict = {"name": p.failpoint, "action": p.action}
+            if p.action == "delay":
+                spec["delay_ms"] = p.delay_ms
+            res = await self._debug_post(node, "/debug/failpoint", spec)
+            assert "error" not in res, f"chaos arm failed: {res}"
+            await asyncio.sleep(p.duration)
+            await self._debug_post(node, "/debug/failpoint",
+                                   {"name": p.failpoint,
+                                    "action": "off"})
         else:  # pragma: no cover - manifest validated
             raise ValueError(p.op)
 

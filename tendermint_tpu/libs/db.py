@@ -133,6 +133,9 @@ class SqliteDB(DB):
         return None if row is None else bytes(row[0])
 
     def set(self, key: bytes, value: bytes) -> None:
+        from . import failpoints
+
+        failpoints.hit("db.set")
         self._c.execute(
             "INSERT INTO kv (k, v) VALUES (?, ?) "
             "ON CONFLICT(k) DO UPDATE SET v = excluded.v", (key, value))
@@ -141,6 +144,9 @@ class SqliteDB(DB):
         self._c.execute("DELETE FROM kv WHERE k = ?", (key,))
 
     def write_batch(self, ops) -> None:
+        from . import failpoints
+
+        failpoints.hit("db.set")
         self._c.execute("BEGIN IMMEDIATE")
         try:
             for k, v in ops:
@@ -237,6 +243,9 @@ class FileDB(MemDB):
                 super().delete(key)
 
     def _append(self, payload: bytes) -> None:
+        from . import failpoints
+
+        failpoints.hit("db.set")
         rec = _HDR.pack(zlib.crc32(payload), len(payload)) + payload
         self._f.write(rec)
         self._f.flush()

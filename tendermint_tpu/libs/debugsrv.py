@@ -26,6 +26,11 @@ Reference: node/node.go:807-812 serves net/http/pprof on
   GET /status                  machine-readable node health: per-
                                subsystem liveness checks aggregated
                                into an ok/degraded/failing verdict
+  GET /debug/failpoint         chaos registry state: every named
+                               point with armed spec + hit counters
+  POST /debug/failpoint        arm/disarm a named failpoint (JSON
+                               body; see libs/failpoints.py and
+                               docs/CHAOS.md)
 
 Used by `tendermint-tpu debug kill|dump` (cmd/) to capture diagnostics
 bundles, mirroring cmd/tendermint/commands/debug/{kill,dump}.go.
@@ -131,6 +136,16 @@ class HealthMonitor:
         else:
             checks["p2p"] = {"status": "degraded", "peers": 0,
                              "detail": "no peers"}
+        # persistent peers abandoned after exhausting reconnect
+        # attempts: connected-or-not, the operator must see them
+        if node is not None and getattr(node, "switch", None) is not None:
+            exhausted = sorted(node.switch.reconnect_exhausted)
+            if exhausted:
+                c = checks["p2p"]
+                c["status"] = "degraded"
+                c["reconnect_exhausted"] = exhausted
+                c["detail"] = (f"{len(exhausted)} persistent peer(s) "
+                               "abandoned after reconnect attempts")
 
         # -- mempool: saturation --
         if node is not None and getattr(node, "mempool", None) is not None:
@@ -157,16 +172,32 @@ class HealthMonitor:
         checks["mempool"] = mp
 
         # -- device: is the accelerator serving, and is the verify
-        # queue draining? --
-        available = cbatch.device_available()
+        # queue draining? Per-backend circuit-breaker states: ed25519
+        # and sr25519 degrade independently. --
+        states = cbatch.breaker_states()
         qdepth = int(tpu_metrics().verify_queue_depth.value())
-        dv: dict = {"queue_depth": qdepth}
-        if available:
+        dv: dict = {"queue_depth": qdepth, "breakers": states}
+        broken = sorted(b for b, s in states.items() if s != "closed")
+        if not broken:
             dv["status"] = "ok"
         else:
             dv["status"] = "degraded"
-            dv["detail"] = "device cooldown: verifying on host"
+            dv["detail"] = ("breaker open ({}): verifying on host"
+                            .format(", ".join(broken)))
         checks["device"] = dv
+
+        # -- chaos: armed failpoints make a node degraded BY DESIGN —
+        # the flag keeps an injection run from masquerading as healthy
+        # (check only present while something is armed) --
+        from . import failpoints
+
+        armed = failpoints.any_armed()
+        if armed:
+            checks["failpoints"] = {
+                "status": "degraded",
+                "detail": "failpoints armed",
+                "armed": armed,
+            }
 
         overall = max((c["status"] for c in checks.values()),
                       key=_RANK.__getitem__)
@@ -277,14 +308,25 @@ class DebugServer:
             parts = line.decode().split(" ")
             if len(parts) < 2:
                 return
-            target = parts[1]
-            while (await reader.readline()) not in (b"\r\n", b"\n", b""):
-                pass
+            method, target = parts[0].upper(), parts[1]
+            clen = 0
+            while True:
+                hline = await reader.readline()
+                if hline in (b"\r\n", b"\n", b""):
+                    break
+                name, _, val = hline.decode("latin-1").partition(":")
+                if name.strip().lower() == "content-length":
+                    try:
+                        clen = min(int(val.strip()), 1 << 20)
+                    except ValueError:
+                        clen = 0
+            req_body = await reader.readexactly(clen) if clen else b""
             path, _, query = target.partition("?")
             params = dict(
                 kv.partition("=")[::2] for kv in query.split("&") if kv
             )
-            body = await self._route(path, params)
+            body = await self._route(path, params, method=method,
+                                     body=req_body)
             ctype = b"text/plain"
             if isinstance(body, tuple):
                 body, ctype = body
@@ -302,11 +344,15 @@ class DebugServer:
             except Exception:
                 pass
 
-    async def _route(self, path: str, params: dict) -> bytes:
+    async def _route(self, path: str, params: dict,
+                     method: str = "GET", body: bytes = b"") -> bytes:
         if path in ("/debug/pprof", "/debug/pprof/"):
             return (b"pprof endpoints: goroutine, heap?seconds=N, "
                     b"profile?seconds=N; also /metrics, /status, "
-                    b"/debug/trace?seconds=N, /debug/trace/rollup\n")
+                    b"/debug/trace?seconds=N, /debug/trace/rollup, "
+                    b"/debug/failpoint (GET state / POST arm)\n")
+        if path == "/debug/failpoint":
+            return self._failpoint_route(method, body)
         if path == "/debug/pprof/goroutine":
             return _goroutine_dump().encode()
         if path == "/debug/pprof/heap":
@@ -352,3 +398,42 @@ class DebugServer:
             return (json.dumps(self.health.status()).encode(),
                     b"application/json")
         return b"unknown path; see /debug/pprof/\n"
+
+    @staticmethod
+    def _failpoint_route(method: str, body: bytes):
+        """GET: catalog + armed state + counters. POST: arm/disarm —
+        {"name": "wal.fsync", "action": "error", "nth": 3} arms;
+        action "off" disarms; {"name": "all", "action": "off"} clears
+        everything. Bad requests come back as {"error": ...} (the tiny
+        HTTP/1.0 server always answers 200)."""
+        import json
+
+        from . import failpoints
+
+        if method != "POST":
+            return (json.dumps(failpoints.state()).encode(),
+                    b"application/json")
+        try:
+            spec = json.loads(body or b"{}")
+            name = spec.get("name", "")
+            action = spec.get("action", "")
+            if action == "off":
+                if name == "all":
+                    failpoints.disarm_all()
+                elif not failpoints.disarm(name):
+                    raise ValueError(f"failpoint {name!r} not armed")
+            else:
+                kwargs = {}
+                for k in ("delay_ms", "prob"):
+                    if k in spec:
+                        kwargs[k] = float(spec[k])
+                for k in ("nth", "every", "count"):
+                    if k in spec:
+                        kwargs[k] = int(spec[k])
+                failpoints.arm(name, action, **kwargs)
+        except (ValueError, TypeError, KeyError) as e:
+            return (json.dumps({"error": str(e)}).encode(),
+                    b"application/json")
+        return (json.dumps({"ok": True,
+                            "armed": failpoints.any_armed()}).encode(),
+                b"application/json")

@@ -1,27 +1,24 @@
-"""Failpoint crash injection (reference: libs/fail/fail.go).
+"""Legacy failpoint shim (reference: libs/fail/fail.go).
 
-Set FAIL_TEST_INDEX=<n>: the n-th fail() call-site reached in this
-process exits hard (os._exit, no cleanup — simulating a crash). Used by
-crash-recovery tests around the WAL and ApplyBlock persistence steps.
+The crash-injection machinery lives in libs/failpoints.py now: the six
+original fail() persistence-boundary call sites are NAMED points
+(consensus.commit.* / state.apply.*) hit through the registry, which
+still honors FAIL_TEST_INDEX with the original ordinal semantics —
+the n-th legacy site reached in the process exits hard (os._exit, no
+cleanup). This module keeps the old import surface working.
+
+FAIL_TEST_INDEX is parsed once at first use; a malformed value is
+logged and ignored instead of raising from inside consensus.
 """
 
 from __future__ import annotations
 
-import os
-
-_counter = -1
+from . import failpoints
 
 
 def fail() -> None:
-    global _counter
-    env = os.environ.get("FAIL_TEST_INDEX")
-    if env is None:
-        return
-    _counter += 1
-    if _counter == int(env):
-        os._exit(1)
+    failpoints.legacy_fail()
 
 
 def reset() -> None:
-    global _counter
-    _counter = -1
+    failpoints.reset()

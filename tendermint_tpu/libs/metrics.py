@@ -357,6 +357,18 @@ class CryptoMetrics:
         "device_failures_total",
         "Device batch launches that raised; host degradation engaged.",
         "crypto"))
+    breaker_state: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "breaker_state",
+        "Device circuit-breaker state by backend "
+        "(0 closed, 1 open, 2 half-open).", "crypto"))
+    breaker_opens: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "breaker_opens_total",
+        "Circuit-breaker closed/half-open -> open transitions, "
+        "by backend.", "crypto"))
+    breaker_probes: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "breaker_probes_total",
+        "Half-open synthetic probe batches, by backend and result.",
+        "crypto"))
 
 
 @dataclass
@@ -379,6 +391,11 @@ class P2PMetrics:
         "message_send_total", "Complete messages sent, by channel.", "p2p"))
     num_txs: Counter = field(default_factory=lambda: DEFAULT.counter(
         "num_txs", "Transactions received from peers.", "p2p"))
+    reconnect_exhausted: Counter = field(
+        default_factory=lambda: DEFAULT.counter(
+            "reconnect_exhausted_total",
+            "Persistent peers abandoned after exhausting reconnect "
+            "attempts.", "p2p"))
 
 
 @dataclass
@@ -433,6 +450,10 @@ class StateSyncMetrics:
     chunks_served: Counter = field(default_factory=lambda: DEFAULT.counter(
         "chunks_served_total", "Snapshot chunks served to peers.",
         "statesync"))
+    chunk_retries: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "chunk_retries_total",
+        "Snapshot chunk fetches re-requested after a miss/timeout.",
+        "statesync"))
 
 
 @dataclass
@@ -477,6 +498,11 @@ class ABCIMetrics:
         default_factory=lambda: DEFAULT.histogram(
             "connection_method_seconds",
             "ABCI call latency, by connection and method.", "abci"))
+    client_reconnects: Counter = field(
+        default_factory=lambda: DEFAULT.counter(
+            "client_reconnects_total",
+            "ABCI client transport reconnect attempts, by result.",
+            "abci"))
 
 
 @dataclass
@@ -528,6 +554,20 @@ class TPUMetrics:
             "expanded_build_seconds",
             "Wall time building expanded comb tables for a valset.", "tpu",
             buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120)))
+
+
+@dataclass
+class FailpointMetrics:
+    """Chaos-injection blast radius (libs/failpoints.py): how often
+    each armed point was evaluated and how often it actually fired —
+    on the same scrape as the degradation it causes."""
+    hits: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "hits_total",
+        "Armed failpoint evaluations, by point.", "failpoint"))
+    fires: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "fires_total",
+        "Failpoint actions actually injected, by point and action.",
+        "failpoint"))
 
 
 @dataclass
@@ -600,6 +640,10 @@ def tracing_metrics() -> TracingMetrics:
     return _singleton("tracing", TracingMetrics)
 
 
+def failpoint_metrics() -> FailpointMetrics:
+    return _singleton("failpoint", FailpointMetrics)
+
+
 # ------------------------------------------------- MetricsProvider wiring
 
 @dataclass
@@ -620,6 +664,7 @@ class NodeMetrics:
     abci: ABCIMetrics
     tpu: TPUMetrics
     tracing: TracingMetrics
+    failpoint: FailpointMetrics
 
 
 def node_metrics() -> NodeMetrics:
@@ -632,7 +677,7 @@ def node_metrics() -> NodeMetrics:
         blockchain=blockchain_metrics(), statesync=statesync_metrics(),
         evidence=evidence_metrics(), state=state_metrics(),
         abci=abci_metrics(), tpu=tpu_metrics(),
-        tracing=tracing_metrics(),
+        tracing=tracing_metrics(), failpoint=failpoint_metrics(),
     )
 
 

@@ -1,6 +1,9 @@
-"""Address helpers shared by node, CLI drivers, and app creators."""
+"""Address + retry helpers shared by node, CLI drivers, and app
+creators."""
 
 from __future__ import annotations
+
+import random
 
 
 def split_laddr(laddr: str,
@@ -9,3 +12,12 @@ def split_laddr(laddr: str,
     addr = laddr[len("tcp://"):] if laddr.startswith("tcp://") else laddr
     host, _, port = addr.rpartition(":")
     return host or default_host, int(port)
+
+
+def jittered_backoff(attempt: int, base: float, cap: float) -> float:
+    """THE retry-delay policy, one copy for every backoff site (p2p
+    persistent-peer reconnect, ABCI client re-dial, statesync chunk
+    re-request, device-breaker cooldown): capped exponential from
+    `base` with ±20 % uniform jitter so a fleet of retriers never
+    thunders in lockstep. `attempt` is 0-based."""
+    return min(base * 2 ** attempt, cap) * (0.8 + 0.4 * random.random())

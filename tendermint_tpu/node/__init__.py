@@ -165,6 +165,15 @@ class Node(Service):
 
         self.metrics = metrics_provider(cfg.instrumentation)(
             self.genesis_doc.chain_id)
+        if cfg.chaos.failpoints:
+            # [chaos] failpoints armed before any subsystem starts so
+            # boot-path injections (db.set, wal.*) catch the very
+            # first writes; config is the strict surface —
+            # validate_basic already rejected malformed specs.
+            from ..libs import failpoints
+
+            failpoints.install_spec(cfg.chaos.failpoints,
+                                    source="config", strict=True)
         self.block_store = BlockStore(_db(cfg, "blockstore",
                                           self.in_memory))
         self.state_store = Store(_db(cfg, "state", self.in_memory))

@@ -15,7 +15,7 @@ import asyncio
 import time
 from dataclasses import dataclass, field
 
-from ...libs import flowrate, tracing
+from ...libs import failpoints, flowrate, tracing
 from ...libs.service import Service
 from .secret_connection import DATA_MAX, SEALED_SIZE, SecretConnection
 
@@ -234,6 +234,11 @@ class MConnection(Service):
                 pkt = bytes([_PKT_MSG, ch.desc.id, 1 if eof else 0]) + \
                     len(frag).to_bytes(2, "big") + frag
                 await self._send_bucket.consume(len(pkt))
+                # chaos: `corrupt` garbles the plaintext packet (the
+                # peer must detect and drop us); `error` kills the
+                # send routine like a socket failure would; `delay`
+                # (async) stalls this peer's sends, not the whole loop
+                pkt = await failpoints.hit_async("p2p.send", payload=pkt)
                 self.conn.write_frame(pkt)
                 ch.recently_sent += len(pkt)
                 ch.send_monitor.update(len(pkt))

@@ -11,7 +11,6 @@ peer down on any reactor/connection error (StopPeerForError).
 from __future__ import annotations
 
 import asyncio
-import random
 
 from ..libs.service import Service
 from .conn.connection import ChannelDescriptor, MConnConfig
@@ -76,6 +75,11 @@ class Switch(Service):
         self.max_inbound = max_inbound
         self.max_outbound = max_outbound
         self._reconnect_tasks: dict[str, asyncio.Task] = {}
+        # persistent-peer addrs abandoned after exhausting reconnect
+        # attempts — flagged by the /status HealthMonitor p2p check and
+        # counted in p2p_reconnect_exhausted_total; cleared when the
+        # peer comes back (inbound or a later successful dial)
+        self.reconnect_exhausted: set[str] = set()
         self._sever_until = 0.0                  # sever() test hook
         self.addr_book = None                    # set by PEX wiring
         self.reporter = None                     # behaviour.SwitchReporter
@@ -164,6 +168,12 @@ class Switch(Service):
             await peer.stop()
             raise SwitchError("duplicate peer (cross-dial race)")
         self.peers[ni.node_id] = peer
+        # a peer that came back on its own un-flags its abandoned
+        # reconnect (it may dial US after a long partition heals)
+        if self.reconnect_exhausted:
+            self.reconnect_exhausted = {
+                a for a in self.reconnect_exhausted
+                if _split_addr(a)[0] != ni.node_id}
         for r in self.reactors.values():
             try:
                 await r.add_peer(peer)
@@ -283,18 +293,33 @@ class Switch(Service):
 
         async def reconnect():
             # exponential backoff (reference: reconnectToPeer switch.go:393)
+            from ..libs.net import jittered_backoff
+
             for attempt in range(20):
-                delay = min(5 * 2 ** attempt, 300) * (0.8 + 0.4 * random.random())
+                delay = jittered_backoff(attempt, 5, 300)
                 await asyncio.sleep(delay if attempt else 1.0)
                 expect_id, _ = _split_addr(addr)
                 if expect_id and expect_id in self.peers:
+                    self.reconnect_exhausted.discard(addr)
                     return
                 try:
                     await self.dial_peer(addr, persistent=True)
+                    self.reconnect_exhausted.discard(addr)
                     return
                 except Exception as e:
                     self.logger.info("reconnect %s attempt %d failed: %s",
                                      addr, attempt + 1, e)
+            # Exhausted: the old behavior abandoned the peer SILENTLY
+            # at info level — an operator learned a validator had been
+            # partitioned only when consensus slowed. Loud error + a
+            # counter + a /status flag instead.
+            self.logger.error(
+                "persistent peer %s unreachable after 20 reconnect "
+                "attempts; giving up (flagged in /status)", addr)
+            self.reconnect_exhausted.add(addr)
+            from ..libs.metrics import p2p_metrics
+
+            p2p_metrics().reconnect_exhausted.inc()
 
         self._reconnect_tasks[addr] = self.spawn(reconnect(),
                                                  f"reconnect-{addr}")

@@ -34,6 +34,7 @@ def instrument_client(client: Client, conn_name: str) -> Client:
     is cached per request TYPE, so the per-call cost on the CheckTx /
     DeliverTx hot path is a dict lookup + bucket scan — no label
     sorting per request."""
+    from ..libs import failpoints
     from ..libs.metrics import abci_metrics
 
     hist = abci_metrics().method_seconds
@@ -46,6 +47,10 @@ def instrument_client(client: Client, conn_name: str) -> Client:
         if ob is None:
             bound[t] = ob = hist.labels(
                 connection=conn_name, method=_snake(t.__name__))
+        # chaos: the one choke point every client type shares — an
+        # armed error here looks exactly like a dead app connection
+        # (async variant: a delay stalls THIS call, not the event loop)
+        await failpoints.hit_async("abci.deliver")
         t0 = time.perf_counter()
         try:
             return await inner(req)

@@ -3,8 +3,9 @@
 apply_block: validate → execute on the consensus ABCI connection
 (BeginBlock, pipelined DeliverTx, EndBlock) → persist responses →
 update state (valset/params deltas) → Commit the app under the mempool
-lock → prune → fire events. fail() crash-points sit between the
-persistence steps exactly like the reference's fail.Fail() calls
+lock → prune → fire events. Named failpoints (libs/failpoints.py)
+sit between the persistence steps exactly like the reference's
+fail.Fail() calls
 (state/execution.go:149-195) so crash-recovery tests can cut the
 process at each boundary."""
 
@@ -12,7 +13,7 @@ from __future__ import annotations
 
 from ..abci import types as abci_t
 from ..abci.client import Client
-from ..libs.fail import fail
+from ..libs.failpoints import hit as _failpoint
 from ..mempool import Mempool, NopMempool, TxPostCheck, TxPreCheck
 from ..types.block import Block, BlockID, Commit
 from ..types.events import (
@@ -152,11 +153,11 @@ class BlockExecutor:
 
         abci_responses = await self._exec_block_on_proxy_app(state, block)
 
-        fail()  # crash-point: block executed, responses not yet saved
+        _failpoint("state.apply.block_executed")
 
         self.store.save_abci_responses(block.header.height, abci_responses)
 
-        fail()  # crash-point: responses saved, state not yet updated
+        _failpoint("state.apply.responses_saved")
 
         end_block: abci_t.ResponseEndBlock = abci_responses["end_block"]
         val_updates = validator_updates_from_abci(end_block.validator_updates)
@@ -180,12 +181,12 @@ class BlockExecutor:
         if self.evpool is not None:
             self.evpool.update(new_state, block.evidence.evidence)
 
-        fail()  # crash-point: app committed, state not yet saved
+        _failpoint("state.apply.app_committed")
 
         new_state.app_hash = app_hash
         self.store.save(new_state)
 
-        fail()  # crash-point: everything saved, events not yet fired
+        _failpoint("state.apply.state_saved")
 
         self._fire_events(block, block_id, abci_responses, val_updates)
         return new_state, retain_height

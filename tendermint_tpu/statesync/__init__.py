@@ -16,10 +16,21 @@ from .messages import (
     decode_ss_msg,
     encode_ss_msg,
 )
-from .reactor import CHUNK_CHANNEL, SNAPSHOT_CHANNEL, StateSyncReactor
 from .snapshots import SnapshotPool
 from .stateprovider import LightClientStateProvider, StateProvider
 from .syncer import StateSyncError, Syncer
+
+
+def __getattr__(name: str):
+    # The reactor is the only submodule that pulls in the p2p stack
+    # (and its optional `cryptography` dependency); loading it lazily
+    # keeps the pure-ish core (Syncer, SnapshotPool, messages) — and
+    # its chaos/unit tests — importable without transport deps.
+    if name in ("StateSyncReactor", "SNAPSHOT_CHANNEL", "CHUNK_CHANNEL"):
+        from . import reactor
+
+        return getattr(reactor, name)
+    raise AttributeError(name)
 
 __all__ = [
     "StateSyncReactor", "SNAPSHOT_CHANNEL", "CHUNK_CHANNEL",

@@ -12,6 +12,8 @@ import asyncio
 import logging
 
 from ..abci import types as abci
+from ..libs import failpoints
+from ..libs.net import jittered_backoff
 from ..light.errors import LightClientError
 from .snapshots import Snapshot, SnapshotPool
 
@@ -20,6 +22,21 @@ logger = logging.getLogger("statesync")
 CHUNK_TIMEOUT = 10.0       # reference chunkTimeout (10s)
 DISCOVERY_TIME = 2.0       # reference defaultDiscoveryTime scaled for tests
 CHUNK_FETCHERS = 4         # reference cfg.ChunkFetchers
+# Per-chunk retry policy: requeued/re-requested chunks back off
+# (capped, jittered) instead of re-dialing the instant a peer says
+# "missing" — the old immediate retry was a hot request loop against
+# peers that just pruned the snapshot. A chunk that exhausts its
+# attempts fails the SNAPSHOT (sync_any moves on to a fresher one)
+# instead of spinning forever.
+CHUNK_RETRIES = 8
+CHUNK_BACKOFF_BASE = 0.2
+CHUNK_BACKOFF_MAX = 5.0
+
+
+def _chunk_backoff(attempt: int) -> float:
+    """Capped exponential backoff with jitter for chunk re-requests."""
+    return jittered_backoff(max(attempt - 1, 0), CHUNK_BACKOFF_BASE,
+                            CHUNK_BACKOFF_MAX)
 
 
 class StateSyncError(Exception):
@@ -85,7 +102,11 @@ class Syncer:
             return
         if not 0 <= msg.index < self._active.chunks:
             return
-        self._chunks[msg.index] = msg.chunk
+        # chaos: `corrupt` delivers garbled chunk bytes — restore must
+        # end in an app-hash mismatch that fails the snapshot, never in
+        # silently applied garbage
+        self._chunks[msg.index] = failpoints.hit("statesync.chunk",
+                                                 payload=msg.chunk)
         self._chunk_event.set()
 
     def remove_peer(self, peer_id: str) -> None:
@@ -188,10 +209,18 @@ class Syncer:
     async def _fetch_and_apply(self, snapshot: Snapshot) -> None:
         applied = 0
         requested: dict[int, float] = {}
+        attempts: dict[int, int] = {}    # fetch attempts per chunk
+        not_before: dict[int, float] = {}  # backoff gate per chunk
         loop = asyncio.get_running_loop()
         while applied < snapshot.chunks:
             while self._requeue:
-                requested[self._requeue.pop()] = 0.0  # retry immediately
+                # the serving peer said "missing": retry WITH backoff
+                # (capped, jittered) — the old immediate retry was a
+                # hot loop against peers that just pruned the snapshot
+                idx = self._requeue.pop()
+                requested[idx] = 0.0
+                not_before[idx] = loop.time() + _chunk_backoff(
+                    attempts.get(idx, 0))
             peers = self.pool.peers_of(snapshot)
             if not peers:
                 raise StateSyncError("no peers hold the snapshot")
@@ -204,7 +233,21 @@ class Syncer:
                 if outstanding >= CHUNK_FETCHERS:
                     break
                 last = requested.get(idx, 0.0)
-                if now - last > CHUNK_TIMEOUT or last == 0.0:
+                due = last == 0.0 or now - last > CHUNK_TIMEOUT
+                if due and now >= not_before.get(idx, 0.0):
+                    n = attempts.get(idx, 0)
+                    if n >= CHUNK_RETRIES:
+                        # exhausted: a fetch FAILURE for the whole
+                        # snapshot, surfaced to sync_any (which moves
+                        # on / re-discovers) — never a silent spin
+                        raise StateSyncError(
+                            f"chunk {idx} exhausted after {n} fetch "
+                            "attempts")
+                    attempts[idx] = n + 1
+                    if n:
+                        from ..libs.metrics import statesync_metrics
+
+                        statesync_metrics().chunk_retries.inc()
                     peer = peers[idx % len(peers)] if last == 0.0 else \
                         peers[(idx + 1) % len(peers)]
                     await self.request_chunk(peer, snapshot, idx)
@@ -226,9 +269,16 @@ class Syncer:
                 self._chunk_event.clear()
                 if applied in self._chunks or self._requeue:
                     continue  # work arrived before the clear: no wait
+                # wake early if a backed-off chunk comes due before the
+                # fetch timeout — backoff must not turn into a stall
+                wait = CHUNK_TIMEOUT
+                now = loop.time()
+                for idx, nb in not_before.items():
+                    if idx not in self._chunks and nb > now:
+                        wait = min(wait, max(nb - now, 0.05))
                 try:
                     await asyncio.wait_for(self._chunk_event.wait(),
-                                           CHUNK_TIMEOUT)
+                                           wait)
                 except asyncio.TimeoutError:
                     # force re-requests next loop
                     for idx in list(requested):

@@ -229,7 +229,7 @@ class ValidatorSet:
         # backend-dependent (expanded.max_keys: HBM budget on chips,
         # one build chunk on the CPU backend where tables buy nothing).
         if not (_EXPAND_MIN <= len(lanes) <= tv._MAX_BATCH
-                and _batch.device_available()):
+                and _batch.device_available("ed25519")):
             return False
         try:
             from ..crypto.tpu import expanded
@@ -237,9 +237,9 @@ class ValidatorSet:
             cap = expanded.max_keys()
         except Exception:
             # max_keys inits the JAX backend; a broken device runtime
-            # must degrade to the host path (with the usual cooldown),
-            # not crash commit verification.
-            _batch.mark_device_failed()
+            # must degrade to the host path (with the usual breaker
+            # cooldown), not crash commit verification.
+            _batch.mark_device_failed("ed25519")
             _batch.logger.exception("backend probe failed; host path")
             return False
         return (len(self.validators) <= cap
@@ -312,8 +312,10 @@ class ValidatorSet:
         # built (_commit_msgs) — don't repeat the O(n) key-type scan.
         if structured or self._use_expanded(lanes):
             from ..crypto.tpu import expanded
+            from ..libs import failpoints
 
             try:
+                failpoints.hit("device.verify")
                 exp = expanded.get_expanded(
                     [v.pub_key.bytes() for v in self.validators])
                 if structured:
@@ -341,7 +343,7 @@ class ValidatorSet:
                 # dead device mid-table-build or mid-launch: degrade
                 # to the BatchVerifier (which itself degrades device
                 # -> host) instead of failing the commit verify
-                _batch.mark_device_failed()
+                _batch.mark_device_failed("ed25519")
                 _batch.logger.exception(
                     "expanded-valset verify failed (%d lanes); "
                     "degrading", len(lanes))

@@ -25,6 +25,7 @@ asserting it.
 
 import asyncio
 import os
+import time
 
 import pytest
 
@@ -119,3 +120,350 @@ def test_failpoint_sweep_height2(tmp_path, fail_index):
     """Crash during the SECOND height's commit: recovery now also
     replays a previously-committed block behind the crashed one."""
     _run_site(tmp_path, fail_index, 100 + 10 * (fail_index - 6))
+
+
+# =====================================================================
+# In-process NAMED failpoint sweep (libs/failpoints.py): every
+# registered point, non-crash shapes. The contract per injection is
+# "recover or degrade, never hang": either the subsystem surfaces a
+# clean failure its caller already handles, or it transparently
+# degrades with correct results. The crash shape is covered by the
+# subprocess sweep above (FAIL_TEST_INDEX drives the six legacy
+# consensus.commit.* / state.apply.* sites through real kills).
+# =====================================================================
+
+from tendermint_tpu.libs import failpoints as fp
+from tendermint_tpu.libs.failpoints import FailpointError
+
+# k%6 ordinal -> registered name: pins the subprocess sweep's index
+# mapping to the catalog so a reordering of the named sites can't
+# silently retarget the crash tests.
+LEGACY_SITE_ORDER = [
+    "consensus.commit.block_saved",      # k%6 == 0
+    "consensus.commit.wal_delimited",    # k%6 == 1
+    "state.apply.block_executed",        # k%6 == 2
+    "state.apply.responses_saved",       # k%6 == 3
+    "state.apply.app_committed",         # k%6 == 4
+    "state.apply.state_saved",           # k%6 == 5
+]
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.reset()
+    yield
+    fp.reset()
+
+
+def test_legacy_site_order_matches_catalog(monkeypatch):
+    """The six legacy sites share one FAIL_TEST_INDEX ordinal in
+    exactly LEGACY_SITE_ORDER — asserted with os._exit stubbed so the
+    mapping is verified in-process, not by killing pytest."""
+    assert [d.name for d in fp.CATALOG if d.legacy_index] == \
+        LEGACY_SITE_ORDER
+    for target, name in enumerate(LEGACY_SITE_ORDER):
+        exits = []
+        monkeypatch.setattr(fp.os, "_exit",
+                            lambda code: exits.append(code))
+        monkeypatch.setenv(fp.LEGACY_ENV_VAR, str(target))
+        fp.reset()
+        for n in LEGACY_SITE_ORDER:
+            fp.hit(n)
+            if n == name:
+                break
+        assert exits == [1], f"site {name} (ordinal {target})"
+    fp.reset()
+
+
+def test_sweep_wal_fsync_error_and_delay(tmp_path):
+    """wal.fsync error surfaces cleanly from write_sync (the consensus
+    caller treats WAL durability loss as fatal-for-this-node — that IS
+    the degradation contract); delay just stalls."""
+    from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
+
+    w = WAL(str(tmp_path / "wal"))
+    w.write_sync(EndHeightMessage(1))
+    fp.arm("wal.fsync", "error")
+    with pytest.raises(FailpointError):
+        w.write_sync(EndHeightMessage(2))
+    fp.reset()
+    fp.arm("wal.fsync", "delay", delay_ms=20)
+    t0 = time.monotonic()
+    w.write_sync(EndHeightMessage(3))
+    assert time.monotonic() - t0 >= 0.015
+    fp.reset()
+    # the record written under the raising fsync still made the file
+    # buffer; after recovery everything valid is replayable
+    w.close()
+    msgs = [m.msg.height for m in WAL.decode_all(str(tmp_path / "wal"))]
+    assert msgs == [1, 2, 3]
+
+
+def test_sweep_wal_torn_write_corrupt_quarantine(tmp_path):
+    """wal.torn_write corrupt = a torn write mid-record. Recovery must
+    keep the valid prefix, QUARANTINE (not delete) the tail, and keep
+    appending cleanly after repair."""
+    from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
+
+    path = str(tmp_path / "wal")
+    w = WAL(path)
+    w.write_sync(EndHeightMessage(1))
+    fp.arm("wal.torn_write", "corrupt", nth=1)
+    w.write_sync(EndHeightMessage(2))        # torn on disk
+    fp.reset()
+    w.write_sync(EndHeightMessage(3))        # lands behind the tear
+    assert [m.msg.height for m in WAL.decode_all(path)] == [1]
+    assert w.repair()
+    qfile = path + ".corrupt.000"
+    assert os.path.exists(qfile) and os.path.getsize(qfile) > 0
+    w.write_sync(EndHeightMessage(4))
+    assert [m.msg.height for m in WAL.decode_all(path)] == [1, 4]
+    w.close()
+
+
+def test_sweep_db_set_error_both_backends(tmp_path):
+    """db.set error: both persistent backends surface a clean
+    exception (no partial in-memory state for FileDB: the append
+    failed before the write)."""
+    from tendermint_tpu.libs.db import FileDB, SqliteDB
+
+    sq = SqliteDB(str(tmp_path / "kv.sqlite"))
+    sq.set(b"a", b"1")
+    fdb = FileDB(str(tmp_path / "kv.db"))
+    fp.arm("db.set", "error")
+    with pytest.raises(FailpointError):
+        sq.set(b"b", b"2")
+    with pytest.raises(FailpointError):
+        sq.write_batch([(b"c", b"3")])
+    with pytest.raises(FailpointError):
+        fdb.set(b"b", b"2")
+    fp.reset()
+    sq.set(b"b", b"2")
+    assert sq.get(b"b") == b"2" and sq.get(b"a") == b"1"
+    fdb.set(b"d", b"4")
+    assert fdb.get(b"d") == b"4"
+    sq.close()
+    fdb.close()
+
+
+def test_sweep_device_verify_error_degrades_to_host():
+    """device.verify error: consensus-critical verification NEVER
+    raises — the breaker opens and host verdicts stay correct (full
+    breaker coverage in tests/test_failpoints.py)."""
+    from tendermint_tpu.crypto import batch as B
+    from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+
+    fp.arm("device.verify", "error")
+    B.reset_breakers()
+    try:
+        sk = Ed25519PrivKey.generate()
+        bv = B.BatchVerifier(use_device=True)
+        bv.add(sk.pub_key(), b"ok", sk.sign(b"ok"))
+        bv.add(sk.pub_key(), b"bad", b"\x00" * 64)
+        ok, v = bv.verify()
+        assert not ok and list(v) == [True, False]
+        assert not B.device_available("ed25519")
+    finally:
+        B.reset_breakers()
+
+
+def test_sweep_abci_deliver_error_and_delay():
+    """abci.deliver error: the proxy caller sees a clean exception at
+    the shared choke point (consensus's replay/handshake owns what
+    happens next); after disarm the same connection keeps serving —
+    with the reconnect hardening there is no permanently dead client."""
+    from tendermint_tpu.abci import types as abci_t
+    from tendermint_tpu.abci.client import ClientCreator
+    from tendermint_tpu.abci.kvstore import KVStoreApp
+    from tendermint_tpu.proxy import AppConns
+
+    async def go():
+        conns = AppConns(ClientCreator(app=KVStoreApp()))
+        await conns.start()
+        try:
+            res = await conns.query.echo("up")
+            assert res.message == "up"
+            fp.arm("abci.deliver", "error", every=1)
+            with pytest.raises(FailpointError):
+                await conns.query.echo("down")
+            fp.reset()
+            fp.arm("abci.deliver", "delay", delay_ms=20)
+            t0 = time.monotonic()
+            res = await conns.query.echo("slow")
+            assert res.message == "slow"
+            assert time.monotonic() - t0 >= 0.015
+            fp.reset()
+            res = await conns.consensus.info(abci_t.RequestInfo())
+            assert res is not None
+        finally:
+            await conns.stop()
+
+    asyncio.run(go())
+
+
+def test_sweep_p2p_send_corrupt_and_error():
+    """p2p.send: `corrupt` garbles one wire packet — the receiving
+    MConnection must either reject it (protocol error -> on_error ->
+    peer drop) or deliver bytes that fail reassembly, NEVER deliver
+    the original message as-if-clean; `error` kills the send routine
+    exactly like a socket failure (on_error path). No hangs."""
+    pytest.importorskip("cryptography")
+    from tendermint_tpu.p2p.conn.connection import (ChannelDescriptor,
+                                                    MConnection)
+
+    class PipeConn:
+        """Duck-typed SecretConnection over asyncio queues."""
+
+        def __init__(self):
+            self.out: asyncio.Queue | None = None
+            self.inb: asyncio.Queue = asyncio.Queue()
+
+        def write_frame(self, data: bytes) -> None:
+            self.out.put_nowait(bytes(data))
+
+        async def read_frame(self) -> bytes:
+            return await self.inb.get()
+
+        async def drain(self) -> None:
+            pass
+
+        def close(self) -> None:
+            pass
+
+    async def go():
+        a, b = PipeConn(), PipeConn()
+        a.out, b.out = b.inb, a.inb
+        recv: list[bytes] = []
+        errors: list[Exception] = []
+        got = asyncio.Event()
+
+        def on_recv(chan, msg):
+            recv.append(msg)
+            got.set()
+
+        def on_err(exc):
+            errors.append(exc)
+            got.set()
+
+        chans = [ChannelDescriptor(id=0x20)]
+        ma = MConnection(a, chans, on_receive=lambda c, m: None)
+        mb = MConnection(b, chans, on_receive=on_recv, on_error=on_err)
+        await ma.start()
+        await mb.start()
+        try:
+            payload = bytes(range(256)) * 4
+            assert ma.try_send(0x20, payload)
+            await asyncio.wait_for(got.wait(), timeout=10)
+            assert recv == [payload] and not errors
+            recv.clear()
+            got.clear()
+            fp.arm("p2p.send", "corrupt", nth=1)
+            assert ma.try_send(0x20, payload)
+            await asyncio.wait_for(got.wait(), timeout=10)
+            assert not recv or recv[0] != payload, \
+                "corrupted packet delivered as-if-clean"
+            fp.reset()
+            # error shape: the send routine dies like a socket failure
+            a2, b2 = PipeConn(), PipeConn()
+            a2.out, b2.out = b2.inb, a2.inb
+            send_errs: list[Exception] = []
+            dead = asyncio.Event()
+            mc = MConnection(a2, chans, on_receive=lambda c, m: None,
+                             on_error=lambda e: (send_errs.append(e),
+                                                 dead.set()))
+            await mc.start()
+            fp.arm("p2p.send", "error", nth=1)
+            assert mc.try_send(0x20, b"boom")
+            await asyncio.wait_for(dead.wait(), timeout=10)
+            assert isinstance(send_errs[0], FailpointError)
+            fp.reset()
+            await mc.stop()
+        finally:
+            fp.reset()
+            await ma.stop()
+            await mb.stop()
+
+    asyncio.run(go())
+
+
+def _chunk_msg(index, chunk=b"", missing=False):
+    from types import SimpleNamespace
+
+    return SimpleNamespace(height=1, format=1, index=index,
+                           chunk=chunk, missing=missing)
+
+
+def test_sweep_statesync_chunk_corrupt_and_error():
+    """statesync.chunk corrupt: the stored chunk differs from the wire
+    chunk (restore then fails at the app-hash confirm — snapshot
+    rejected, next one tried); error: surfaces from add_chunk (the
+    reactor's receive error path drops the peer)."""
+    from tendermint_tpu.statesync.snapshots import Snapshot
+    from tendermint_tpu.statesync.syncer import Syncer
+
+    async def go():
+        snap = Snapshot(height=1, format=1, chunks=2, hash=b"h")
+        s = Syncer(None, None, request_chunk=None)
+        s.pool.add("peerA", snap)
+        s._active = snap
+        fp.arm("statesync.chunk", "corrupt")
+        s.add_chunk(_chunk_msg(0, b"\xaa" * 64), peer_id="peerA")
+        assert s._chunks[0] != b"\xaa" * 64
+        fp.reset()
+        fp.arm("statesync.chunk", "error")
+        with pytest.raises(FailpointError):
+            s.add_chunk(_chunk_msg(1, b"\xbb" * 64), peer_id="peerA")
+
+    asyncio.run(go())
+
+
+def test_statesync_requeue_backoff_and_exhaustion(monkeypatch):
+    """The satellite at syncer.py:194: requeued chunks used to retry
+    with NO delay (a hot loop against peers that pruned the snapshot).
+    Now every re-request backs off (capped, jittered) and a chunk that
+    exhausts its attempts fails the snapshot as a clean fetch failure."""
+    from tendermint_tpu.statesync import syncer as sy
+    from tendermint_tpu.statesync.snapshots import Snapshot
+
+    monkeypatch.setattr(sy, "CHUNK_RETRIES", 3)
+    monkeypatch.setattr(sy, "CHUNK_BACKOFF_BASE", 0.02)
+    monkeypatch.setattr(sy, "CHUNK_BACKOFF_MAX", 0.05)
+
+    async def go():
+        snap = Snapshot(height=1, format=1, chunks=1, hash=b"h")
+        times: list[float] = []
+        holder: dict = {}
+
+        async def request_chunk(peer, snapshot, idx):
+            times.append(asyncio.get_running_loop().time())
+            # the peer immediately answers "missing": requeue
+            holder["s"].add_chunk(_chunk_msg(idx, missing=True),
+                                  peer_id="")
+
+        s = sy.Syncer(None, None, request_chunk=request_chunk)
+        holder["s"] = s
+        s.pool.add("peerA", snap)
+        s._active = snap
+        with pytest.raises(sy.StateSyncError, match="exhausted"):
+            await asyncio.wait_for(s._fetch_and_apply(snap), timeout=30)
+        assert len(times) == 3  # the attempt cap, not a hot loop
+        gaps = [b - a for a, b in zip(times, times[1:])]
+        assert all(g >= 0.015 for g in gaps), gaps  # backoff, not 0
+
+    asyncio.run(go())
+
+
+def test_check_failpoints_lint_from_sweep():
+    """Every registered point documented + tested + wired (the
+    tools/check_failpoints.py contract) — run from the suite like
+    check_spans/check_metrics."""
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools")
+    import sys
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import check_failpoints
+
+    problems = check_failpoints.collect_problems()
+    assert not problems, "\n".join(problems)

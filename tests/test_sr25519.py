@@ -253,7 +253,7 @@ def test_batch_verifier_routes_sr25519_to_device():
     from tendermint_tpu.crypto import batch as batch_mod
     from tendermint_tpu.libs.metrics import crypto_metrics
 
-    batch_mod._device_down_until = 0.0  # clear any cooldown from
+    batch_mod.reset_breakers()  # clear any breaker state from
     # earlier tests — this test is about routing, not degradation
     n = batch_mod._DEVICE_THRESHOLD_SR + 16
     lanes_before = crypto_metrics().batch_lanes.value(

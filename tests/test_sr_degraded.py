@@ -63,9 +63,11 @@ def test_device_failure_degrades_to_cpu_jit(monkeypatch):
         ok, lanes = bv.verify()
         assert calls == [False, True], calls
         assert not ok and int(lanes.sum()) == N - 1 and not lanes[7]
-        assert not batch_mod.device_available()  # cooldown armed
+        assert not batch_mod.device_available()  # breaker opened
+        assert not batch_mod.device_available("sr25519")
+        assert batch_mod.device_available("ed25519")  # independent
     finally:
-        batch_mod._device_down_until = 0.0
+        batch_mod.reset_breakers()
 
 
 def test_explicit_host_mode_keeps_oracle(monkeypatch):

@@ -301,7 +301,7 @@ def test_expanded_backend_cap_gates_use_expanded(monkeypatch):
     import tendermint_tpu.crypto.batch as _batch
 
     monkeypatch.setattr(exmod, "max_keys", boom)
-    monkeypatch.setattr(_batch, "_device_down_until", 0.0)
+    _batch.reset_breakers()
     assert not vals._use_expanded(lanes)
-    assert not _batch.device_available()   # cooldown engaged
-    monkeypatch.setattr(_batch, "_device_down_until", 0.0)
+    assert not _batch.device_available("ed25519")  # breaker opened
+    _batch.reset_breakers()

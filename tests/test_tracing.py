@@ -184,7 +184,7 @@ def test_consensus_height_timeline_and_trace_endpoint(tmp_path):
             # above stay under _DEVICE_THRESHOLD and take the host
             # path). CPU JAX backend; clear any cooldown a previous
             # test's simulated device failure left behind.
-            cbatch._device_down_until = 0.0
+            cbatch.reset_breakers()
             bv = cbatch.BatchVerifier(use_device=True)
             for i in range(4):
                 k = Ed25519PrivKey.from_secret(b"trace-%d" % i)

@@ -221,11 +221,13 @@ def test_net_stays_live_under_persistent_device_failure():
         orig_vb, orig_thr = tv.verify_batch, B._DEVICE_THRESHOLD
         tv.verify_batch = boom
         B._DEVICE_THRESHOLD = 1
-        B._device_down_until = 0.0
-        # make the cooldown expire constantly so the dead device is
-        # RETRIED during the run (worst case), not just skipped
-        orig_cd = B.DEVICE_RETRY_COOLDOWN_S
-        B.DEVICE_RETRY_COOLDOWN_S = 0.05
+        B.reset_breakers()
+        # make the breaker cooldown expire constantly so the dead
+        # device is PROBED during the run (worst case: failing
+        # half-open probes interleaved with consensus), not just
+        # skipped while open
+        orig_cd = B.BREAKER_BASE_COOLDOWN_S
+        B.BREAKER_BASE_COOLDOWN_S = 0.05
         try:
             wire_network(nodes)
             await asyncio.gather(*[
@@ -234,8 +236,8 @@ def test_net_stays_live_under_persistent_device_failure():
         finally:
             tv.verify_batch = orig_vb
             B._DEVICE_THRESHOLD = orig_thr
-            B.DEVICE_RETRY_COOLDOWN_S = orig_cd
-            B._device_down_until = 0.0
+            B.BREAKER_BASE_COOLDOWN_S = orig_cd
+            B.reset_breakers()
             for n in nodes:
                 await n.stop()
 
@@ -243,9 +245,9 @@ def test_net_stays_live_under_persistent_device_failure():
 
 
 def test_device_failure_cooldown_and_recovery():
-    """A raising device marks itself down for a cooldown (host
-    verdicts, correct), is not retried while down, and is picked back
-    up after the cooldown without a restart."""
+    """A raising device opens its circuit breaker (host verdicts,
+    correct), is not retried while the breaker is open, and is picked
+    back up once the breaker closes — without a restart."""
     from tendermint_tpu.crypto import batch as B
     from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
     from tendermint_tpu.crypto.tpu import verify as tv
@@ -258,7 +260,7 @@ def test_device_failure_cooldown_and_recovery():
 
     orig = tv.verify_batch
     tv.verify_batch = boom
-    B._device_down_until = 0.0
+    B.reset_breakers()
     try:
         sk = Ed25519PrivKey.generate()
         msg, sig = b"m", None
@@ -267,18 +269,19 @@ def test_device_failure_cooldown_and_recovery():
         bv.add(sk.pub_key(), msg, sig)
         ok, v = bv.verify()
         assert ok and list(v) == [True]  # host fallback, same verdict
-        assert len(calls) == 1 and not B.device_available()
-        # down: device not retried
+        assert len(calls) == 1 and not B.device_available("ed25519")
+        # open: production batches take the host path, no launches
         bv2 = B.BatchVerifier(use_device=True)
         bv2.add(sk.pub_key(), msg, sig)
         assert bv2.verify()[0]
         assert len(calls) == 1
-        # cooldown expired: device retried
-        B._device_down_until = 0.0
+        # breaker closed again (a successful probe would do this):
+        # the device is retried without a restart
+        B.reset_breakers()
         bv3 = B.BatchVerifier(use_device=True)
         bv3.add(sk.pub_key(), msg, sig)
         assert bv3.verify()[0]
         assert len(calls) == 2
     finally:
         tv.verify_batch = orig
-        B._device_down_until = 0.0
+        B.reset_breakers()

@@ -6,7 +6,13 @@ the log line scrolled by, and the net limped. This harness makes that
 class of failure loud: after the run, any Traceback / "died" /
 "Task exception" line in any node log fails the soak.
 
-    python tools/soak.py [--minutes 5] [--nodes 4] [--out DIR]
+    python tools/soak.py [--minutes 5] [--nodes 4] [--out DIR] [--chaos]
+
+--chaos interleaves failpoint injections (libs/failpoints.py via each
+node's POST /debug/failpoint) with the process-level perturbations:
+slow fsyncs, slow DB writes, ABCI delivery stalls and a dead device
+window — the graceful-degradation paths must carry the net through
+without a wedge or a silent task death.
 """
 
 import asyncio
@@ -30,8 +36,53 @@ ALLOWED = re.compile(
     rb"|connection lost|flood")
 
 
+# The --chaos injection rotation: degrade-don't-kill shapes (a crash
+# is the `kill` op's job). Each arms for a few seconds on one node.
+CHAOS_ROTATION = (
+    {"failpoint": "wal.fsync", "action": "delay", "delay_ms": 25},
+    {"failpoint": "db.set", "action": "delay", "delay_ms": 10},
+    {"failpoint": "device.verify", "action": "error"},
+    {"failpoint": "abci.deliver", "action": "delay", "delay_ms": 10},
+)
+
+
+# Injected faults legitimately log tracebacks (the degradation
+# handlers use logger.exception). In chaos mode a HARD line whose
+# following ~40 lines mention the injection is EXPECTED noise; a
+# traceback without that fingerprint is still a real bug.
+_INJECTED = re.compile(rb"FailpointError|injected failpoint")
+_EXCUSE_WINDOW = 40
+
+
+def _sweep_log(log_path: str, node_i: int, chaos: bool) -> list:
+    """Streaming sweep — soak logs can run to hundreds of MB, so the
+    chaos excuse window is a bounded pending list, never a whole-file
+    buffer."""
+    bad = []
+    pending = []  # chaos mode: (line_no, text) HARD hits awaiting excuse
+    with open(log_path, "rb") as f:
+        for line_no, line in enumerate(f, 1):
+            if chaos:
+                if _INJECTED.search(line):
+                    pending.clear()  # everything in-window is excused
+                else:
+                    while pending and \
+                            line_no - pending[0][0] > _EXCUSE_WINDOW:
+                        bad.append((node_i,) + pending.pop(0))
+            if HARD.search(line):
+                text = line.rstrip()[:160]
+                if chaos:
+                    pending.append((line_no, text))
+                else:
+                    bad.append((node_i, line_no, text))
+            elif WEAK.search(line) and not ALLOWED.search(line):
+                bad.append((node_i, line_no, line.rstrip()[:160]))
+    bad.extend((node_i,) + p for p in pending)  # unexcused at EOF
+    return bad
+
+
 def main() -> int:
-    minutes, nodes, out = 5.0, 4, "./soak-net"
+    minutes, nodes, out, chaos = 5.0, 4, "./soak-net", False
     for i, a in enumerate(sys.argv):
         if a == "--minutes":
             minutes = float(sys.argv[i + 1])
@@ -39,6 +90,8 @@ def main() -> int:
             nodes = int(sys.argv[i + 1])
         elif a == "--out":
             out = sys.argv[i + 1]
+        elif a == "--chaos":
+            chaos = True
 
     from tendermint_tpu.e2e import Manifest, Runner
 
@@ -54,6 +107,19 @@ def main() -> int:
             "at_height": 5 + k * max(5, total_h // max(int(minutes), 1)),
             "duration": 3.0,
         })
+    if chaos:
+        # offset from the process perturbations so both fault classes
+        # are live in the same run without hitting the same node at
+        # the same instant
+        for k in range(int(minutes)):
+            perturbs.append({
+                "node": (k + 1) % nodes,
+                "op": "chaos",
+                "at_height": 8 + k * max(
+                    5, total_h // max(int(minutes), 1)),
+                "duration": 4.0,
+                **CHAOS_ROTATION[k % len(CHAOS_ROTATION)],
+            })
     m = Manifest.from_dict({
         "chain_id": "soak-chain",
         "nodes": nodes,
@@ -69,12 +135,8 @@ def main() -> int:
 
     bad = []
     for i in range(nodes):
-        log_path = os.path.join(out, f"node{i}", "node.log")
-        with open(log_path, "rb") as f:
-            for line_no, line in enumerate(f, 1):
-                if HARD.search(line) or (
-                        WEAK.search(line) and not ALLOWED.search(line)):
-                    bad.append((i, line_no, line.rstrip()[:160]))
+        bad.extend(_sweep_log(
+            os.path.join(out, f"node{i}", "node.log"), i, chaos))
     if bad:
         print(f"SOAK FAILED: {len(bad)} suspect log lines:")
         for node_i, line_no, line in bad[:40]:
