@@ -81,6 +81,13 @@ class Client(Service):
         DeliverTx path (reference: socket_client.go DeliverTxAsync)."""
         return asyncio.get_running_loop().create_task(self.deliver(req))
 
+    def in_flight(self) -> int:
+        """Requests accepted but not yet answered on this connection —
+        the admission-control window the mempool's busy check reads
+        (mempool/clist_mempool.py): a saturated app must shed new
+        CheckTx work, not queue it unboundedly."""
+        return 0
+
 
 class LocalClient(Client):
     """In-process client; one lock per connection serializes app calls
@@ -91,6 +98,7 @@ class LocalClient(Client):
         super().__init__(name="abci.LocalClient")
         self.app = app
         self._lock = lock or asyncio.Lock()
+        self._in_flight = 0
 
     async def deliver(self, req):
         if isinstance(req, t.RequestEcho):
@@ -98,8 +106,17 @@ class LocalClient(Client):
         if isinstance(req, t.RequestFlush):
             return t.ResponseFlush()
         method = t.HANDLERS[type(req)]
-        async with self._lock:
-            return getattr(self.app, method)(req)
+        # waiting on the shared app lock counts as in flight: that IS
+        # the saturated-app condition admission control sheds on
+        self._in_flight += 1
+        try:
+            async with self._lock:
+                return getattr(self.app, method)(req)
+        finally:
+            self._in_flight -= 1
+
+    def in_flight(self) -> int:
+        return self._in_flight
 
 
 # --- socket framing: varint length prefix + JSON message ---------------------
@@ -240,6 +257,9 @@ class SocketClient(Client):
 
     async def flush(self) -> None:
         await self.deliver(t.RequestFlush())
+
+    def in_flight(self) -> int:
+        return self._pending.qsize()
 
 
 class ClientCreator:

@@ -78,6 +78,16 @@ class GRPCClient(Client):
         method = METHOD_BY_TYPE.get(type(req))
         if method is None:
             raise ABCIClientError(f"unknown request {type(req).__name__}")
+        self._in_flight = getattr(self, "_in_flight", 0) + 1
+        try:
+            return await self._deliver_rpc(method, req)
+        finally:
+            self._in_flight -= 1
+
+    def in_flight(self) -> int:
+        return getattr(self, "_in_flight", 0)
+
+    async def _deliver_rpc(self, method, req):
         try:
             resp = await self._stub(method)(req)
             self._unavailable_streak = 0

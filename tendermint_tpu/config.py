@@ -63,10 +63,20 @@ class RPCConfig:
     timeout_broadcast_tx_commit_ms: int = 10000
     max_body_bytes: int = 1000000
     pprof_laddr: str = ""
+    # Overload limiter (rpc/jsonrpc.py): at most this many requests in
+    # flight at once (0 = unlimited), and a token-bucket request rate
+    # with ~1 s of burst (0 = unlimited). Excess requests get a
+    # 429-style JSON-RPC error instead of queueing unboundedly.
+    max_concurrent_requests: int = 256
+    rate_limit_rps: float = 0.0
 
     def validate_basic(self) -> None:
         if self.timeout_broadcast_tx_commit_ms < 0:
             raise ValueError("negative broadcast timeout")
+        if self.max_concurrent_requests < 0:
+            raise ValueError("negative max_concurrent_requests")
+        if self.rate_limit_rps < 0:
+            raise ValueError("negative rate_limit_rps")
 
 
 @dataclass
@@ -90,6 +100,16 @@ class P2PConfig:
     # ensurePeersPeriod, 30s). Short-lived test nets lower it so
     # seed-bootstrap discovery converges within the run.
     pex_ensure_period_s: float = 30.0
+    # Slow-peer escalation (p2p/switch.py + libs/overload.py
+    # SlowPeerTracker): a peer whose unsent backlog
+    # (pending_send_bytes) sits at/above the high-water mark for
+    # consecutive scan intervals escalates skip-gossip -> demote ->
+    # disconnect (non-persistent only). 0 high-water disables.
+    slow_peer_pending_bytes: int = 1 << 20
+    slow_peer_check_interval_s: float = 2.0
+    slow_peer_skip_strikes: int = 2
+    slow_peer_demote_strikes: int = 4
+    slow_peer_disconnect_strikes: int = 8
 
     def validate_basic(self) -> None:
         if self.max_num_inbound_peers < 0 or self.max_num_outbound_peers < 0:
@@ -98,6 +118,16 @@ class P2PConfig:
             raise ValueError("negative flush throttle")
         if self.pex_ensure_period_s <= 0:
             raise ValueError("pex_ensure_period_s must be positive")
+        if self.slow_peer_pending_bytes < 0:
+            raise ValueError("negative slow_peer_pending_bytes")
+        if self.slow_peer_check_interval_s <= 0:
+            raise ValueError("slow_peer_check_interval_s must be positive")
+        if not (0 < self.slow_peer_skip_strikes
+                <= self.slow_peer_demote_strikes
+                <= self.slow_peer_disconnect_strikes):
+            raise ValueError(
+                "slow_peer strikes must satisfy 0 < skip <= demote "
+                "<= disconnect")
 
 
 @dataclass
@@ -110,10 +140,17 @@ class MempoolConfig:
     cache_size: int = 10000
     keep_invalid_txs_in_cache: bool = False
     max_tx_bytes: int = 1048576
+    # CheckTx admission control: reject with MempoolBusyError when
+    # this many CheckTx requests are already in flight on the ABCI
+    # mempool connection (0 = unlimited) — a saturated app window must
+    # shed new admissions, not queue them unboundedly.
+    checktx_max_inflight: int = 1024
 
     def validate_basic(self) -> None:
         if self.size < 0 or self.cache_size < 0 or self.max_tx_bytes < 0:
             raise ValueError("negative mempool limits")
+        if self.checktx_max_inflight < 0:
+            raise ValueError("negative checktx_max_inflight")
 
 
 @dataclass
@@ -165,6 +202,15 @@ class ConsensusConfig:
     # verifies each vote synchronously like the reference.
     vote_batch_window_ms: float = 2.0
     vote_batch_max: int = 1024
+    # Overload bounds (libs/overload.py): the serialized receive
+    # funnel is split by class — state/vote/proposal messages get a
+    # blocking (backpressure) queue, block parts / catchup data get a
+    # shed-when-full queue — and the vote-scheduler buffer is capped
+    # (excess votes are shed and re-gossiped via votebits
+    # reconciliation once pressure clears).
+    peer_funnel_votes_size: int = 1024
+    peer_funnel_data_size: int = 512
+    vote_buf_max: int = 4096
 
     def propose_timeout(self, round_: int) -> float:
         return (self.timeout_propose_ms
@@ -189,6 +235,10 @@ class ConsensusConfig:
                      "double_sign_check_height"):
             if getattr(self, name) < 0:
                 raise ValueError(f"negative {name}")
+        for name in ("peer_funnel_votes_size", "peer_funnel_data_size",
+                     "vote_buf_max"):
+            if getattr(self, name) <= 0:
+                raise ValueError(f"{name} must be positive")
 
 
 def fast_consensus_config() -> ConsensusConfig:

@@ -524,6 +524,16 @@ class ConsensusReactor(Reactor):
                         m.encode_consensus_msg(_new_valid_block_msg(
                             rs, rs.proposal_block_parts,
                             is_commit=True)))
+                # demoted slow peer (switch slow-peer escalation): its
+                # send queue cannot absorb bulk data — pause block-part
+                # and catchup gossip (steps 1-3) until it drains. The
+                # tiny state-class advert ABOVE stays exempt: skipping
+                # it would re-open the wedged-at-COMMIT-forever hole
+                # the periodic re-advert exists to close. Votes/state
+                # routines keep serving the peer throughout.
+                if getattr(peer, "slow_level", 0) >= 2:
+                    await asyncio.sleep(self.gossip_sleep)
+                    continue
                 # 1) send a proposal block part the peer lacks
                 if rs.height == ps.height and rs.round == ps.round and \
                         rs.proposal_block_parts is not None and \

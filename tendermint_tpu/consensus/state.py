@@ -22,6 +22,7 @@ from dataclasses import dataclass
 from ..config import ConsensusConfig
 from ..libs import tracing
 from ..libs.failpoints import hit as _failpoint
+from ..libs.overload import CONTROLLER, PriorityFunnel
 from ..libs.service import Service
 from ..mempool import Mempool, NopMempool
 from ..state import State as SmState
@@ -73,7 +74,15 @@ class ConsensusState(Service):
 
         self.rs = RoundState()
         self.state: SmState | None = None
-        self.peer_msg_queue: asyncio.Queue[_QueuedMsg] = asyncio.Queue(1000)
+        # Priority-split bounded receive funnel (libs/overload.py):
+        # state/vote/proposal messages block the sender when full
+        # (backpressure, the reference's peerMsgQueue channel send);
+        # block parts / catchup data shed when full — a gossip flood
+        # must not starve round progression or grow memory unboundedly.
+        self.peer_funnel = PriorityFunnel(
+            config.peer_funnel_votes_size, config.peer_funnel_data_size,
+            high_queue="consensus.funnel.votes",
+            low_queue="consensus.funnel.data")
         self.internal_msg_queue: asyncio.Queue[_QueuedMsg] = asyncio.Queue(1000)
         self.ticker = TimeoutTicker()
         self._replay_mode = False
@@ -86,6 +95,9 @@ class ConsensusState(Service):
         # (vote, peer_id, pub_key) triples awaiting one device batch.
         self._vote_buf: list = []
         self._vote_pending = asyncio.Event()
+        CONTROLLER.register("consensus.vote_buf",
+                            lambda: len(self._vote_buf),
+                            config.vote_buf_max, owner=self)
         self._tpu_metrics = None  # lazy tpu_metrics() handle (hot path)
         self._height_done = asyncio.Event()  # pulsed on every commit
         # reactor hooks: fn(event_name, payload); events: "step",
@@ -128,6 +140,11 @@ class ConsensusState(Service):
 
     async def on_stop(self) -> None:
         self.ticker.stop()
+        # drop overload registrations: a stopped node's frozen queue
+        # depths must not pin the process-wide level (owner-checked —
+        # a newer in-process node's same-name entries survive)
+        self.peer_funnel.close()
+        CONTROLLER.unregister("consensus.vote_buf", owner=self)
         if self.wal is not None:
             self.wal.close()
 
@@ -252,7 +269,7 @@ class ConsensusState(Service):
     async def _receive_routine(self) -> None:
         while True:
             internal = asyncio.ensure_future(self.internal_msg_queue.get())
-            peer = asyncio.ensure_future(self.peer_msg_queue.get())
+            peer = asyncio.ensure_future(self.peer_funnel.get())
             timeout = asyncio.ensure_future(self.ticker.queue.get())
             done, pending = await asyncio.wait(
                 [internal, peer, timeout],
@@ -863,6 +880,20 @@ class ConsensusState(Service):
         vs = self._target_vote_set(vote)
         if vs is not None and vs.is_duplicate(vote):
             return True  # already tallied; don't burn a device lane
+        if len(self._vote_buf) >= self.config.vote_buf_max:
+            if not peer_id:
+                # our OWN vote (internal loopback): no peer holds it,
+                # so a shed here would silently skip our prevote/
+                # precommit for the round — take the sync path instead
+                return False
+            # Bounded scheduler buffer: shedding a PEER vote (not the
+            # sync path — seconds of on-loop crypto is the failure
+            # mode this exists to prevent) is safe because gossip
+            # re-sends votes the votebits reconciliation shows we
+            # still lack.
+            CONTROLLER.shed("consensus.vote_buf")
+            self._vote_pending.set()  # make sure the drain is awake
+            return True
         # vals rides along so the scheduler can route the batch
         # through the expanded structured path (validator-index lanes
         # against the SAME set pk was resolved from).
@@ -916,7 +947,12 @@ class ConsensusState(Service):
             await self._vote_pending.wait()
             t_window = _time.perf_counter()
             window = self.config.vote_batch_window_ms / 1e3
-            if window > 0 and len(self._vote_buf) < self.config.vote_batch_max:
+            # Early flush under pressure: once the buffer passes half
+            # its bound, waiting out the batching window only deepens
+            # the backlog (and the shedding it causes) — verify NOW.
+            if window > 0 and \
+                    len(self._vote_buf) < self.config.vote_batch_max and \
+                    len(self._vote_buf) * 2 < self.config.vote_buf_max:
                 await asyncio.sleep(window)
             batch, self._vote_buf = self._vote_buf, []
             tmet.verify_queue_depth.set(0)
@@ -1307,19 +1343,58 @@ class ConsensusState(Service):
 
     # -- public API (reactor / rpc) --
 
+    def _funnel_class(self, msg) -> bool:
+        """True = high class (round-critical: votes, proposals — the
+        messages that move steps); False = low class (bulk data that
+        is re-gossiped on demand and may be shed under flood)."""
+        return isinstance(msg, (m.VoteMessage, m.ProposalMessage))
+
+    def _shed_duplicate_vote(self, msg) -> bool:
+        """Under funnel pressure, a vote already tallied is the first
+        thing to shed: it would burn a funnel slot and a device lane
+        to change nothing. Only consulted once the funnel is half
+        full — the normal path stays probe-free."""
+        if not isinstance(msg, m.VoteMessage) or \
+                not self.peer_funnel.pressured():
+            return False
+        vs = self._target_vote_set(msg.vote)
+        if vs is not None and vs.is_duplicate(msg.vote):
+            # advisory: the drop is counted, but losing an ALREADY-
+            # TALLIED duplicate is not information loss — it must not
+            # flip the process-wide level to "shedding" during the
+            # ordinary multi-peer gossip redundancy of a busy round
+            CONTROLLER.shed("consensus.funnel.votes", advisory=True)
+            return True
+        return False
+
     async def add_peer_msg(self, msg, peer_id: str) -> None:
-        """Blocks when the queue is full — backpressure onto the
-        calling peer's recv loop, matching the reference's
-        `cs.peerMsgQueue <- msgInfo` channel send (state.go:456).
-        Found by the 10k-validator scale test: a burst larger than
-        msgQueueSize must slow the sender down, not raise QueueFull
-        in the reactor."""
-        await self.peer_msg_queue.put(_QueuedMsg(msg, peer_id))
+        """Priority-aware admission into the bounded funnel. High
+        class blocks when full — backpressure onto the calling peer's
+        recv loop, matching the reference's `cs.peerMsgQueue <-
+        msgInfo` channel send (state.go:456; the 10k-validator scale
+        test pinned that a burst must slow the sender, not raise).
+        Low class (block parts / catchup) sheds when full instead:
+        missing parts are re-requested by gossip, and a data flood
+        must never wedge votes behind it."""
+        qm = _QueuedMsg(msg, peer_id)
+        if self._funnel_class(msg):
+            if self._shed_duplicate_vote(msg):
+                return
+            await self.peer_funnel.put_high(qm)
+        else:
+            self.peer_funnel.put_low(qm)
 
     def add_peer_msg_nowait(self, msg, peer_id: str) -> None:
         """Non-blocking variant for sync call sites (test hooks);
-        raises QueueFull instead of applying backpressure."""
-        self.peer_msg_queue.put_nowait(_QueuedMsg(msg, peer_id))
+        raises QueueFull for the high class instead of applying
+        backpressure (the low class sheds, as in add_peer_msg)."""
+        qm = _QueuedMsg(msg, peer_id)
+        if self._funnel_class(msg):
+            if self._shed_duplicate_vote(msg):
+                return
+            self.peer_funnel.put_high_nowait(qm)
+        else:
+            self.peer_funnel.put_low(qm)
 
     def get_round_state(self) -> RoundState:
         return self.rs

@@ -76,6 +76,12 @@ def generate(rng: random.Random) -> Manifest:
                 fpname, action = rng.choice(chaos_choices)
                 kwargs = {"failpoint": fpname, "action": action,
                           "delay_ms": rng.choice((10, 25, 50))}
+            elif op == "overload":
+                # throttle one of the two host hot paths under flood
+                fpname = rng.choice(("device.verify", "abci.deliver"))
+                kwargs = {"failpoint": fpname, "action": "delay",
+                          "delay_ms": rng.choice((10, 25)),
+                          "tx_rate": rng.choice((100.0, 200.0))}
             m.perturbations.append(Perturbation(
                 node=i,
                 op=op,
@@ -144,10 +150,12 @@ def to_toml(m: Manifest) -> str:
         out += ["", "[[perturbations]]", f"node = {p.node}",
                 f'op = "{p.op}"', f"at_height = {p.at_height}",
                 f"duration = {p.duration}"]
-        if p.op == "chaos":
+        if p.op in ("chaos", "overload"):
             out += [f'failpoint = "{p.failpoint}"',
                     f'action = "{p.action}"',
                     f"delay_ms = {p.delay_ms}"]
+        if p.op == "overload":
+            out += [f"tx_rate = {p.tx_rate}"]
     for vu in m.validator_updates:
         out += ["", "[[validator_updates]]", f"node = {vu.node}",
                 f"at_height = {vu.at_height}", f"power = {vu.power}"]

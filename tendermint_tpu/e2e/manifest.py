@@ -32,9 +32,14 @@ from dataclasses import dataclass, field
 # TCP severance via the switch's sever() hook (peers observe connection
 # RESETS and must re-dial — reference perturb.go severs the docker net);
 # chaos = arm a named failpoint (libs/failpoints.py) on the node via
-# its POST /debug/failpoint endpoint for `duration` seconds
+# its POST /debug/failpoint endpoint for `duration` seconds;
+# overload = tx-flood the node at `tx_rate`/s WHILE a delay failpoint
+# (default device.verify) throttles its hot path — liveness under
+# overload as an asserted invariant: heights keep advancing, shed
+# counters climb, bounded queues stay bounded, and the /status
+# overload level clears after the window
 OPS = ("kill", "pause", "disconnect", "disconnect_hard", "restart",
-       "chaos")
+       "chaos", "overload")
 
 
 @dataclass
@@ -43,10 +48,12 @@ class Perturbation:
     op: str
     at_height: int
     duration: float = 3.0
-    # chaos op only: which failpoint, what shape, how slow
+    # chaos/overload ops: which failpoint, what shape, how slow
     failpoint: str = ""
     action: str = "delay"
     delay_ms: float = 25.0
+    # overload op only: broadcast_tx_async flood rate (txs/s)
+    tx_rate: float = 200.0
 
     def validate(self, n_nodes: int) -> None:
         if self.op not in OPS:
@@ -72,6 +79,20 @@ class Perturbation:
                 raise ValueError(
                     f"chaos action must be error|delay|corrupt, "
                     f"not {self.action!r}")
+        if self.op == "overload":
+            from ..libs.failpoints import BY_NAME
+
+            if self.failpoint and self.failpoint not in BY_NAME:
+                raise ValueError(
+                    f"unknown overload failpoint {self.failpoint!r}")
+            if self.action not in ("delay", "error"):
+                # overload models a SLOW (or host-degraded) hot path
+                # under flood; corrupt/crash are other ops' jobs
+                raise ValueError(
+                    f"overload action must be delay|error, "
+                    f"not {self.action!r}")
+            if self.tx_rate <= 0:
+                raise ValueError("overload tx_rate must be positive")
 
 
 @dataclass
@@ -222,7 +243,8 @@ class Manifest:
                        "validator_updates", "late_statesync_node",
                        "abci", "privval", "seed_bootstrap"})
     _PERTURB_KEYS = frozenset({"node", "op", "at_height", "duration",
-                               "failpoint", "action", "delay_ms"})
+                               "failpoint", "action", "delay_ms",
+                               "tx_rate"})
     _MISBEHAVIOR_KEYS = frozenset({"node", "spec"})
     _VALUPDATE_KEYS = frozenset({"node", "at_height", "power"})
 
@@ -263,6 +285,7 @@ class Manifest:
                     failpoint=p.get("failpoint", ""),
                     action=p.get("action", "delay"),
                     delay_ms=float(p.get("delay_ms", 25.0)),
+                    tx_rate=float(p.get("tx_rate", 200.0)),
                 )
                 for p in d.get("perturbations", [])
             ],

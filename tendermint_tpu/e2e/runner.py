@@ -64,6 +64,50 @@ async def wait_progress(sample, done, *, timeout: float = 120.0,
         await asyncio.sleep(0.25)
 
 
+async def tx_flood(submit, rate: float, duration: float,
+                   prefix: bytes = b"flood",
+                   max_outstanding: int = 256) -> int:
+    """Paced unique-tx flood: fire `submit(tx_bytes)` at `rate` txs/s
+    for `duration` seconds, swallowing per-tx errors (429 sheds and
+    perturbed nodes are the POINT of the exercise). Pacing is against
+    an ABSOLUTE deadline with fire-and-forget submissions (bounded
+    in-flight) — awaiting each submit inline would let the target's
+    own slowness throttle the flood below the rate it is supposed to
+    overrun, defeating the overload scenario exactly when it bites.
+    Returns the number of submissions attempted. Shared by the e2e
+    `overload` perturbation (submit = RPC broadcast) and
+    tools/net_stress.py --overload (in-process funnel injection)."""
+    start = time.monotonic()
+    sent = 0
+    tasks: set = set()
+
+    async def one(tx: bytes) -> None:
+        try:
+            await submit(tx)
+        except Exception:
+            pass
+
+    loop = asyncio.get_running_loop()
+    while True:
+        now = time.monotonic()
+        if now >= start + duration:
+            break
+        behind = int((now - start) * rate) + 1 - sent
+        for _ in range(max(behind, 0)):
+            tx = b"%s-%d-%d" % (prefix, id(submit) & 0xFFFF, sent)
+            t = loop.create_task(one(tx))
+            tasks.add(t)
+            t.add_done_callback(tasks.discard)
+            sent += 1
+            if len(tasks) >= max_outstanding:
+                await asyncio.wait(tasks,
+                                   return_when=asyncio.FIRST_COMPLETED)
+        await asyncio.sleep(min(1.0 / rate, 0.05))
+    if tasks:
+        await asyncio.wait(tasks, timeout=10.0)
+    return sent
+
+
 def _child_env() -> dict:
     """Env for e2e child processes. FORCE cpu (not setdefault): e2e
     nets are CPU-only by design — an inherited accelerator platform
@@ -220,6 +264,9 @@ class Runner:
         self.apps: list[AppProc] = []
         self.signers: list[SignerProc] = []
         self.seed: NodeProc | None = None
+        # one report dict per applied `overload` perturbation —
+        # heights/levels/shed deltas for the liveness assertions
+        self.overload_reports: list[dict] = []
 
     # -- stages --
 
@@ -260,11 +307,22 @@ class Runner:
                    for p in self.m.perturbations):
                 cfg.rpc.unsafe = True  # exposes unsafe_net_sever
             pprof_port = 0
-            if any(p.op == "chaos" for p in self.m.perturbations):
-                # chaos perturbations drive the node's debug endpoint
-                # (POST /debug/failpoint) — give every node one
+            if any(p.op in ("chaos", "overload")
+                   for p in self.m.perturbations):
+                # chaos/overload perturbations drive the node's debug
+                # endpoint (POST /debug/failpoint, GET /status,
+                # GET /metrics) — give every node one
                 pprof_port = self.base_port + 4000 + i
                 cfg.rpc.pprof_laddr = f"tcp://127.0.0.1:{pprof_port}"
+            if any(p.op == "overload" and p.node == i
+                   for p in self.m.perturbations):
+                # Test-scale RPC budget for the flood target (like the
+                # test-speed PEX cadence above): the tx flood must be
+                # able to overrun the token bucket within a
+                # seconds-long window so shedding is OBSERVABLE — the
+                # debug endpoint (pprof port) is not rate limited, so
+                # the runner's own sampling still gets through.
+                cfg.rpc.rate_limit_rps = 50.0
             if seed_str is not None:
                 # the ONLY configured contact is the seed: the mesh
                 # must form via PEX address-book discovery (fast
@@ -435,6 +493,35 @@ class Runner:
         head, _, resp_body = raw.partition(b"\r\n\r\n")
         return json.loads(resp_body)
 
+    async def _debug_get(self, node: NodeProc, path: str) -> bytes:
+        """GET from the node's debug server; raw body bytes."""
+        assert node.pprof_port, "node has no debug endpoint configured"
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", node.pprof_port)
+        try:
+            writer.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+            await writer.drain()
+            raw = await asyncio.wait_for(reader.read(), timeout=10)
+        finally:
+            writer.close()
+        _, _, body = raw.partition(b"\r\n\r\n")
+        return body
+
+    @staticmethod
+    def _sum_metric(metrics_text: str, name: str) -> float:
+        """Sum every sample of a counter/gauge family in Prometheus
+        text exposition (labels collapse)."""
+        total = 0.0
+        for line in metrics_text.splitlines():
+            if line.startswith(name) and not line.startswith("#"):
+                head, _, val = line.rpartition(" ")
+                if head.partition("{")[0] == name:
+                    try:
+                        total += float(val)
+                    except ValueError:
+                        pass
+        return total
+
     async def height_of(self, node: NodeProc) -> int:
         st = await self._rpc(node, "status")
         return int(st["sync_info"]["latest_block_height"])
@@ -539,6 +626,8 @@ class Runner:
             self.log(f"perturb: node{p.node} dropped "
                      f"{res['connections_dropped']} conns")
             await asyncio.sleep(p.duration)
+        elif p.op == "overload":
+            await self._apply_overload(p, node)
         elif p.op == "chaos":
             # arm a named failpoint through the node's debug endpoint
             # for the window, then disarm — the net must degrade and
@@ -555,6 +644,79 @@ class Runner:
                                     "action": "off"})
         else:  # pragma: no cover - manifest validated
             raise ValueError(p.op)
+
+    async def _apply_overload(self, p: Perturbation,
+                              node: NodeProc) -> None:
+        """Create overload DETERMINISTICALLY (PR 3's chaos levers): a
+        delay failpoint throttles the node's hot path while a tx flood
+        arrives faster than it can drain — then verify the node
+        degrades gracefully: heights advance monotonically, at least
+        one shed counter climbs, no tracked queue exceeds its bound,
+        and the /status overload level clears after the window."""
+        import base64
+        import json
+
+        fp = p.failpoint or "device.verify"
+        spec: dict = {"name": fp, "action": p.action}
+        if p.action == "delay":
+            spec["delay_ms"] = p.delay_ms
+        res = await self._debug_post(node, "/debug/failpoint", spec)
+        assert "error" not in res, f"overload arm failed: {res}"
+
+        before = (await self._debug_get(node, "/metrics")).decode()
+        shed_before = self._sum_metric(before, "overload_shed_total")
+
+        async def submit(tx: bytes) -> None:
+            await self._rpc(node, "broadcast_tx_async",
+                            tx=base64.b64encode(tx).decode())
+
+        flood = asyncio.get_running_loop().create_task(
+            tx_flood(submit, p.tx_rate, p.duration))
+        heights: list[int] = []
+        levels: list[str] = []
+        bounded = True
+        try:
+            while not flood.done():
+                try:
+                    # sample via the DEBUG endpoint: the RPC listener
+                    # is deliberately shedding right now
+                    st = json.loads(await self._debug_get(node,
+                                                          "/status"))
+                    heights.append(
+                        st["checks"]["consensus"]["height"])
+                    oc = st["checks"].get("overload", {})
+                    levels.append(oc.get("level", "?"))
+                    for q in oc.get("queues", {}).values():
+                        if q["capacity"] and q["depth"] > q["capacity"]:
+                            bounded = False
+                except Exception:
+                    pass  # the node is BUSY; that's the scenario
+                await asyncio.sleep(0.5)
+        finally:
+            sent = await flood
+            await self._debug_post(node, "/debug/failpoint",
+                                   {"name": fp, "action": "off"})
+
+        after = (await self._debug_get(node, "/metrics")).decode()
+        shed_delta = self._sum_metric(after, "overload_shed_total") \
+            - shed_before
+        # recovery: the overload level must clear once the flood stops
+        cleared = False
+        for _ in range(60):
+            try:
+                st = json.loads(await self._debug_get(node, "/status"))
+                if st["checks"]["overload"]["level"] == "ok":
+                    cleared = True
+                    break
+            except Exception:
+                pass
+            await asyncio.sleep(1.0)
+        report = {"node": p.node, "failpoint": fp, "txs_sent": sent,
+                  "heights": heights, "levels": levels,
+                  "shed_delta": shed_delta, "bounded": bounded,
+                  "cleared": cleared}
+        self.overload_reports.append(report)
+        self.log(f"perturb: overload report {report}")
 
     # -- validator-set schedule (reference manifest.go validator
     # schedules; kvstore "val:<pub>!<power>" txs route through

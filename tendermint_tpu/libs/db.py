@@ -11,9 +11,12 @@ trust split between tm-db and the WAL."""
 from __future__ import annotations
 
 import bisect
+import logging
 import os
 import struct
 import zlib
+
+logger = logging.getLogger("libs.db")
 
 
 class DB:
@@ -221,11 +224,39 @@ class FileDB(MemDB):
                 break  # torn tail from a crash: drop it
             self._apply_payload(body)
             pos += _HDR.size + ln
-        if pos < len(data):  # truncate the torn tail
+        if pos < len(data):
+            # Torn tail from a crash (or a bad disk): QUARANTINE the
+            # bytes to <db>.corrupt.NNN before truncating, like the
+            # consensus WAL's repair() — a truncate that cut more than
+            # a crash tail must leave the evidence for post-mortem,
+            # never silently destroy it.
+            tail = data[pos:]
+            qpath = self._quarantine_path()
+            with open(qpath, "wb") as qf:
+                qf.write(tail)
+                qf.flush()
+                os.fsync(qf.fileno())
             with open(self.path, "r+b") as f:
                 f.truncate(pos)
+            logger.warning(
+                "FileDB replay: quarantined %d torn tail bytes of %s "
+                "to %s", len(tail), self.path, qpath)
         self._log_bytes = pos
         self._live_bytes = sum(len(k) + len(v) for k, v in self._m.items())
+
+    QUARANTINE_SLOTS = 8
+
+    def _quarantine_path(self) -> str:
+        """First free `<path>.corrupt.NNN` slot, capped: a crash-
+        looping node (chaos kill perturbations) must not accumulate
+        quarantine files without bound. The earliest slots — the first
+        evidence, usually the interesting one — are preserved; once
+        all slots exist, the NEWEST slot is reused."""
+        for n in range(self.QUARANTINE_SLOTS):
+            p = f"{self.path}.corrupt.{n:03d}"
+            if not os.path.exists(p):
+                return p
+        return f"{self.path}.corrupt.{self.QUARANTINE_SLOTS - 1:03d}"
 
     def _apply_payload(self, body: bytes) -> None:
         pos = 0

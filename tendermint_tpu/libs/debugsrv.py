@@ -186,6 +186,30 @@ class HealthMonitor:
                             .format(", ".join(broken)))
         checks["device"] = dv
 
+        # -- overload: the backpressure controller's aggregate view
+        # (libs/overload.py) — "pressured" and "shedding" are degraded
+        # but NOT failing: shedding under flood is the designed
+        # behavior, and the level must clear on its own once load
+        # drops (the liveness-under-overload e2e asserts exactly
+        # that round trip) --
+        from .overload import CONTROLLER
+
+        osnap = CONTROLLER.evaluate()
+        oc: dict = {"level": osnap["level"],
+                    "status": "ok" if osnap["level"] == "ok"
+                    else "degraded"}
+        hot = {name: q for name, q in osnap["queues"].items()
+               if q["fill"] >= 0.5}
+        if hot:
+            oc["queues"] = hot
+        if osnap["level"] != "ok":
+            oc["detail"] = (f"worst queue fill "
+                            f"{osnap['worst_fill']:.2f}; shedding"
+                            if osnap["level"] == "shedding"
+                            else f"worst queue fill "
+                                 f"{osnap['worst_fill']:.2f}")
+        checks["overload"] = oc
+
         # -- chaos: armed failpoints make a node degraded BY DESIGN —
         # the flag keeps an injection run from masquerading as healthy
         # (check only present while something is armed) --

@@ -396,6 +396,15 @@ class P2PMetrics:
             "reconnect_exhausted_total",
             "Persistent peers abandoned after exhausting reconnect "
             "attempts.", "p2p"))
+    send_drops: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "send_drops_total",
+        "Messages dropped on full send queues (try_send/broadcast), "
+        "by channel.", "p2p"))
+    slow_peer_events: Counter = field(
+        default_factory=lambda: DEFAULT.counter(
+            "slow_peer_events_total",
+            "Slow-peer escalation transitions "
+            "(skip/demote/disconnect/recover).", "p2p"))
 
 
 @dataclass
@@ -571,6 +580,46 @@ class FailpointMetrics:
 
 
 @dataclass
+class RPCMetrics:
+    """JSON-RPC server overload surface (this framework's addition):
+    the 429-style limiter and the bounded websocket event queue."""
+    ws_events_dropped: Counter = field(
+        default_factory=lambda: DEFAULT.counter(
+            "ws_events_dropped_total",
+            "Websocket events dropped (drop-oldest) from the bounded "
+            "client notification queue.", "rpc"))
+    requests_rejected: Counter = field(
+        default_factory=lambda: DEFAULT.counter(
+            "requests_rejected_total",
+            "JSON-RPC requests rejected by the overload limiter "
+            "(429-style), by reason.", "rpc"))
+    requests_in_flight: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "requests_in_flight",
+        "JSON-RPC requests currently being handled.", "rpc"))
+
+
+@dataclass
+class OverloadMetrics:
+    """The overload controller's aggregate view (libs/overload.py):
+    one level gauge plus per-tracked-queue depth/capacity/shed — the
+    numbers the liveness-under-overload e2e asserts on."""
+    level: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "level",
+        "Aggregate overload level (0 ok, 1 pressured, 2 shedding).",
+        "overload"))
+    queue_depth: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "queue_depth",
+        "Current depth of each tracked bounded queue.", "overload"))
+    queue_capacity: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "queue_capacity",
+        "Configured bound of each tracked queue.", "overload"))
+    shed: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "shed_total",
+        "Items dropped by shedding policy, by tracked queue.",
+        "overload"))
+
+
+@dataclass
 class TracingMetrics:
     """The generic half of the tracing→metrics bridge: span kinds with
     no dedicated histogram land here, labelled by kind."""
@@ -644,6 +693,14 @@ def failpoint_metrics() -> FailpointMetrics:
     return _singleton("failpoint", FailpointMetrics)
 
 
+def rpc_metrics() -> RPCMetrics:
+    return _singleton("rpc", RPCMetrics)
+
+
+def overload_metrics() -> OverloadMetrics:
+    return _singleton("overload", OverloadMetrics)
+
+
 # ------------------------------------------------- MetricsProvider wiring
 
 @dataclass
@@ -665,6 +722,8 @@ class NodeMetrics:
     tpu: TPUMetrics
     tracing: TracingMetrics
     failpoint: FailpointMetrics
+    rpc: RPCMetrics
+    overload: OverloadMetrics
 
 
 def node_metrics() -> NodeMetrics:
@@ -678,6 +737,7 @@ def node_metrics() -> NodeMetrics:
         evidence=evidence_metrics(), state=state_metrics(),
         abci=abci_metrics(), tpu=tpu_metrics(),
         tracing=tracing_metrics(), failpoint=failpoint_metrics(),
+        rpc=rpc_metrics(), overload=overload_metrics(),
     )
 
 

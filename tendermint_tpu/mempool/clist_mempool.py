@@ -24,6 +24,7 @@ from dataclasses import dataclass, field
 from ..abci import types as abci
 from ..config import MempoolConfig
 from ..libs.clist import CList
+from ..libs.overload import CONTROLLER
 from ..types.tx import tx_hash
 from . import Mempool
 
@@ -38,6 +39,18 @@ class TxInMempoolError(Exception):
 class MempoolFullError(Exception):
     def __init__(self, n_txs: int, tx_bytes: int):
         super().__init__(f"mempool full: {n_txs} txs, {tx_bytes} bytes")
+
+
+class MempoolBusyError(Exception):
+    """Admission shed: the ABCI mempool connection's in-flight window
+    is saturated — the app cannot keep up with CheckTx arrivals, so
+    new txs are rejected EXPLICITLY (429-style at the RPC layer)
+    instead of queueing behind a backlog that only grows."""
+
+    def __init__(self, in_flight: int, limit: int):
+        super().__init__(
+            f"mempool busy: {in_flight} CheckTx in flight "
+            f"(limit {limit}); retry later")
 
 
 class TxTooLargeError(Exception):
@@ -106,6 +119,8 @@ class CListMempool(Mempool):
         self._notify_available: asyncio.Event = asyncio.Event()
         if config.wal_dir:
             self._open_wal(config.wal_dir)
+        CONTROLLER.register("mempool.pool", self.size,
+                            lambda: self.config.size, owner=self)
 
     # --- sizes ---------------------------------------------------------------
 
@@ -114,6 +129,27 @@ class CListMempool(Mempool):
 
     def tx_bytes(self) -> int:
         return self._tx_bytes
+
+    def admission_error(self, tx_len: int = 0) -> Exception | None:
+        """The exception admission control would raise for a tx of
+        `tx_len` bytes right now, or None to admit — the ONE place
+        the full/busy distinction is made (check_tx raises it; the
+        RPC broadcast preflight maps it to a 429)."""
+        if (self.size() >= self.config.size
+                or self._tx_bytes + tx_len > self.config.max_txs_bytes):
+            return MempoolFullError(self.size(), self._tx_bytes)
+        max_if = self.config.checktx_max_inflight
+        if max_if > 0:
+            in_flight = getattr(self.client, "in_flight", lambda: 0)()
+            if in_flight >= max_if:
+                # the pool has room but the app window is saturated:
+                # shed EXPLICITLY instead of queueing behind a CheckTx
+                # backlog the device-bound host cannot drain
+                return MempoolBusyError(in_flight, max_if)
+        return None
+
+    def overloaded(self) -> bool:
+        return self.admission_error() is not None
 
     # --- commit-window lock --------------------------------------------------
 
@@ -180,6 +216,12 @@ class CListMempool(Mempool):
             self._wal.close()
             self._wal = None
 
+    def close(self) -> None:
+        """Teardown: drop the WAL handle and the overload
+        registration (owner-checked — a newer pool's entry survives)."""
+        self.close_wal()
+        CONTROLLER.unregister("mempool.pool", owner=self)
+
     # --- CheckTx admission ---------------------------------------------------
 
     async def check_tx(self, tx: bytes, tx_info: dict | None = None):
@@ -195,9 +237,10 @@ class CListMempool(Mempool):
             err = self.precheck(tx)
             if err is not None:
                 raise ValueError(f"precheck: {err}")
-        if (self.size() >= self.config.size
-                or self._tx_bytes + len(tx) > self.config.max_txs_bytes):
-            raise MempoolFullError(self.size(), self._tx_bytes)
+        admission_err = self.admission_error(len(tx))
+        if admission_err is not None:
+            CONTROLLER.shed("mempool.pool")
+            raise admission_err
 
         key = tx_hash(tx)
         if not self.cache.push(key):

@@ -96,10 +96,15 @@ class MempoolReactor(Reactor):
             while True:
                 mtx = e.value
                 # hold txs the peer can't process yet (reference checks
-                # peer height >= mtx height - 1)
+                # peer height >= mtx height - 1) — and hold ALL tx
+                # gossip while the switch has the peer marked slow
+                # (slow_level >= 1): tx bytes are the most shoveable
+                # load, and piling them onto a saturated send queue
+                # only evicts consensus traffic behind them
                 while True:
                     ph = self._peer_height(peer)
-                    if ph >= mtx.height - 1:
+                    if ph >= mtx.height - 1 and \
+                            getattr(peer, "slow_level", 0) < 1:
                         break
                     await asyncio.sleep(_PEER_CATCHUP_SLEEP)
                 if peer.id not in mtx.senders:

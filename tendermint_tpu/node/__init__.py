@@ -337,10 +337,19 @@ class Node(Service):
             dial_timeout=cfg.p2p.dial_timeout_s,
             conn_filters=conn_filters)
         holder["transport"] = self.transport
-        self.switch = Switch(self.transport, node_info,
-                             max_inbound=cfg.p2p.max_num_inbound_peers,
-                             max_outbound=cfg.p2p.max_num_outbound_peers,
-                             peer_filters=peer_filters)
+        from ..libs.overload import SlowPeerPolicy
+
+        self.switch = Switch(
+            self.transport, node_info,
+            max_inbound=cfg.p2p.max_num_inbound_peers,
+            max_outbound=cfg.p2p.max_num_outbound_peers,
+            peer_filters=peer_filters,
+            slow_peer_policy=SlowPeerPolicy(
+                pending_bytes_hiwater=cfg.p2p.slow_peer_pending_bytes,
+                skip_strikes=cfg.p2p.slow_peer_skip_strikes,
+                demote_strikes=cfg.p2p.slow_peer_demote_strikes,
+                disconnect_strikes=cfg.p2p.slow_peer_disconnect_strikes),
+            slow_peer_check_interval_s=cfg.p2p.slow_peer_check_interval_s)
         # Peer-quality bookkeeping: EWMA trust metrics (persisted) fed
         # by reactor behaviour reports; collapsed trust disconnects
         # (behaviour.py, p2p/trust.py — reference behaviour/ + ADR-006)
@@ -524,6 +533,8 @@ class Node(Service):
         if self.switch.reporter is not None:
             self.switch.reporter.trust.save()
         await self.switch.stop()
+        if hasattr(self.mempool, "close"):
+            self.mempool.close()
         await self.proxy_app.stop()
 
     # -- conveniences --

@@ -16,6 +16,7 @@ import time
 from dataclasses import dataclass, field
 
 from ...libs import failpoints, flowrate, tracing
+from ...libs.overload import CONTROLLER
 from ...libs.service import Service
 from .secret_connection import DATA_MAX, SEALED_SIZE, SecretConnection
 
@@ -189,18 +190,35 @@ class MConnection(Service):
         return True
 
     def try_send(self, chan_id: int, msg: bytes) -> bool:
-        """Non-blocking send; False if the queue is full."""
+        """Non-blocking send; False if the queue is full. Drops are
+        COUNTED (p2p_send_drops_total + the overload controller's
+        shed signal): a broadcast quietly losing messages to a full
+        channel is exactly the saturation evidence an operator needs
+        on the same scrape as the stall it explains."""
         ch = self.channels.get(chan_id)
         if ch is None or not self.is_running:
             return False
         try:
             ch.queue.put_nowait(msg)
         except asyncio.QueueFull:
+            self._met.send_drops.inc(ch=f"{chan_id:#04x}")
+            CONTROLLER.shed("p2p.send")
             return False
         ch.pending_bytes += len(msg)
         self._met.pending_send_bytes.inc(len(msg))
         self._send_signal.set()
         return True
+
+    def pending_send_bytes(self) -> int:
+        """Unsent backlog across channels — the slow-peer monitor's
+        high-water signal (reference: ConnectionStatus SendQueueSize;
+        ours is byte-accurate from the per-channel pending counters)."""
+        return sum(ch.pending_bytes for ch in self.channels.values())
+
+    def send_rate(self) -> float:
+        """Aggregate EWMA send rate (bytes/s) across channels, from
+        the existing flowrate monitors."""
+        return sum(ch.send_monitor.rate for ch in self.channels.values())
 
     def _pick_channel(self) -> _Channel | None:
         """Least recently_sent/priority ratio among channels with data

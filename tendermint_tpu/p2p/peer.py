@@ -22,6 +22,10 @@ class Peer:
         self.persistent = persistent
         self.socket_addr = socket_addr      # actual remote "host:port"
         self._kv: dict[str, object] = {}
+        # Slow-peer escalation level (set by Switch._scan_slow_peers):
+        # 0 healthy, 1 skip tx gossip, 2 also skip bulk data gossip
+        # (votes/state keep flowing). Reactors consult it read-only.
+        self.slow_level = 0
         self.mconn = MConnection(conn, channels,
                                  on_receive=lambda ch, msg: on_receive(self, ch, msg),
                                  on_error=lambda e: on_error(self, e),
@@ -46,6 +50,12 @@ class Peer:
 
     def try_send(self, chan_id: int, msg: bytes) -> bool:
         return self.mconn.try_send(chan_id, msg)
+
+    def pending_send_bytes(self) -> int:
+        return self.mconn.pending_send_bytes()
+
+    def send_rate(self) -> float:
+        return self.mconn.send_rate()
 
     def get(self, key: str):
         return self._kv.get(key)

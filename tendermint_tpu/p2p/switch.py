@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 
+from ..libs.overload import CONTROLLER, SlowPeerPolicy, SlowPeerTracker
 from ..libs.service import Service
 from .conn.connection import ChannelDescriptor, MConnConfig
 from .node_info import NodeInfo
@@ -56,7 +57,9 @@ class Switch(Service):
     def __init__(self, transport: Transport, node_info_fn,
                  mconn_config: MConnConfig | None = None,
                  max_inbound: int = 40, max_outbound: int = 10,
-                 peer_filters: list | None = None):
+                 peer_filters: list | None = None,
+                 slow_peer_policy: SlowPeerPolicy | None = None,
+                 slow_peer_check_interval_s: float = 2.0):
         super().__init__(name="p2p.Switch")
         self.transport = transport
         self.node_info_fn = node_info_fn
@@ -83,6 +86,12 @@ class Switch(Service):
         self._sever_until = 0.0                  # sever() test hook
         self.addr_book = None                    # set by PEX wiring
         self.reporter = None                     # behaviour.SwitchReporter
+        # Slow-peer escalation: pending_send_bytes high-water strikes
+        # -> skip-gossip -> demote -> disconnect (non-persistent). The
+        # decision logic is the pure SlowPeerTracker; this class only
+        # samples and enforces.
+        self.slow_peers = SlowPeerTracker(slow_peer_policy)
+        self.slow_peer_check_interval_s = slow_peer_check_interval_s
 
     # -- assembly --
 
@@ -104,8 +113,21 @@ class Switch(Service):
         for r in self.reactors.values():
             await r.start()
         self.spawn(self._accept_routine(), "switch-accept")
+        if self.slow_peers.policy.pending_bytes_hiwater > 0:
+            self.spawn(self._slow_peer_routine(), "switch-slow-peers")
+        # aggregate p2p send-queue saturation for the overload level
+        CONTROLLER.register(
+            "p2p.send",
+            lambda: sum(ch.queue.qsize()
+                        for p in self.peers.values()
+                        for ch in p.mconn.channels.values()),
+            lambda: sum(ch.desc.send_queue_capacity
+                        for p in self.peers.values()
+                        for ch in p.mconn.channels.values()),
+            owner=self)
 
     async def on_stop(self) -> None:
+        CONTROLLER.unregister("p2p.send", owner=self)
         for t in self._reconnect_tasks.values():
             t.cancel()
         for peer in list(self.peers.values()):
@@ -249,6 +271,48 @@ class Switch(Service):
     def add_persistent_peers(self, addrs: list[str]) -> None:
         self.persistent_addrs.extend(addrs)
 
+    # -- slow-peer escalation --
+
+    async def _slow_peer_routine(self) -> None:
+        while True:
+            await asyncio.sleep(self.slow_peer_check_interval_s)
+            try:
+                await self._scan_slow_peers()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self.logger.exception("slow-peer scan failed")
+
+    async def _scan_slow_peers(self) -> list[tuple[str, str]]:
+        """One monitoring pass: strike peers whose unsent backlog sits
+        at the high-water mark, enforce the tracker's escalation
+        transitions. A peer that cannot drain is distinguishable from
+        a dead one precisely because its conn is alive while
+        pending_send_bytes stays pinned — the ping/pong keepalive
+        never fires, so without this a wedged-but-breathing peer holds
+        its gossip slots forever. Returns [(peer_id, action)] for
+        tests/ops."""
+        from ..libs.metrics import p2p_metrics
+
+        met = p2p_metrics()
+        actions: list[tuple[str, str]] = []
+        for peer in list(self.peers.values()):
+            pending = peer.pending_send_bytes()
+            action = self.slow_peers.observe(peer.id, pending,
+                                             peer.is_persistent())
+            if action is None:
+                continue
+            actions.append((peer.id, action))
+            met.slow_peer_events.inc(action=action)
+            peer.slow_level = self.slow_peers.level(peer.id)
+            self.logger.warning(
+                "slow peer %r: %s (pending %dB, draining %.0fB/s)",
+                peer, action, pending, peer.send_rate())
+            if action == "disconnect":
+                await self.stop_peer_for_error(
+                    peer, f"slow peer: {pending}B pending send backlog")
+        return actions
+
     # -- teardown --
 
     def _on_peer_error(self, peer: Peer, exc: Exception) -> None:
@@ -277,6 +341,7 @@ class Switch(Service):
 
     async def _remove_peer(self, peer: Peer, reason) -> None:
         self.peers.pop(peer.id, None)
+        self.slow_peers.forget(peer.id)
         if self.reporter is not None:
             self.reporter.disconnected(peer.id)  # pause its trust metric
         for r in self.reactors.values():

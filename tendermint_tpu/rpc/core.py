@@ -463,8 +463,32 @@ class Environment:
 
     # -- txs --
 
+    @staticmethod
+    def _busy_error(e: Exception) -> RPCError:
+        """Admission sheds surface as explicit 429-style errors so a
+        load generator can distinguish 'back off' from 'bad tx'."""
+        from .jsonrpc import CODE_BUSY
+
+        return RPCError(CODE_BUSY, f"mempool overloaded: {e}")
+
     async def broadcast_tx_async(self, ctx, tx="") -> dict:
         raw = _tx_bytes(tx)
+        # Preflight admission: fire-and-forget must still SHED visibly
+        # when the pool/app window is saturated — silently spawning a
+        # doomed CheckTx task hides overload from the one caller who
+        # could slow down.
+        mp = self.node.mempool
+        admission_err = getattr(mp, "admission_error",
+                                lambda n=0: None)(len(raw))
+        if admission_err is not None:
+            # count the shed here: the CheckTx task that would have
+            # recorded it is never spawned, and a flood rejected only
+            # on this path must still move overload_shed_total and
+            # the /status level (parity with broadcast_tx_sync)
+            from ..libs.overload import CONTROLLER
+
+            CONTROLLER.shed("mempool.pool")
+            raise self._busy_error(admission_err)
         # hold a strong ref: the loop only weak-refs tasks, and a GC'd
         # task would silently drop the tx
         task = asyncio.get_running_loop().create_task(
@@ -481,9 +505,14 @@ class Environment:
             return e
 
     async def broadcast_tx_sync(self, ctx, tx="") -> dict:
+        from ..mempool.clist_mempool import MempoolBusyError, \
+            MempoolFullError
+
         raw = _tx_bytes(tx)
         try:
             res = await self.node.mempool.check_tx(raw)
+        except (MempoolBusyError, MempoolFullError) as e:
+            raise self._busy_error(e) from e
         except Exception as e:
             raise RPCError(-32603, f"tx rejected: {e}") from e
         return {"code": res.code, "data": _b64(res.data or b""),
@@ -544,8 +573,13 @@ class Environment:
         subscriber = f"tx-commit-{h.hex()[:16]}"
         sub = bus.subscribe(subscriber, query_for_event("Tx"))
         try:
+            from ..mempool.clist_mempool import MempoolBusyError, \
+                MempoolFullError
+
             try:
                 check = await self.node.mempool.check_tx(raw)
+            except (MempoolBusyError, MempoolFullError) as e:
+                raise self._busy_error(e) from e
             except Exception as e:
                 raise RPCError(-32603, f"tx rejected: {e}") from e
             if check.code != abci.CODE_TYPE_OK:
@@ -837,7 +871,12 @@ async def serve(env: Environment, host: str, port: int):
     """Build the server and start listening; returns (server, port)."""
     from .jsonrpc import JSONRPCServer
 
-    srv = JSONRPCServer(env.routes(), env.ws_routes())
+    rpc_cfg = env.node.config.rpc
+    srv = JSONRPCServer(
+        env.routes(), env.ws_routes(),
+        max_body=rpc_cfg.max_body_bytes,
+        max_concurrent=rpc_cfg.max_concurrent_requests,
+        rate_limit_rps=rpc_cfg.rate_limit_rps)
     srv._on_ws_close = env.on_ws_close
     actual = await srv.listen(host, port)
     return srv, actual

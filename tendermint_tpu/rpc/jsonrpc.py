@@ -17,12 +17,23 @@ import base64
 import hashlib
 import json
 import logging
+import time
 import urllib.parse
+
+from ..libs.overload import CONTROLLER, DropOldestQueue
 
 logger = logging.getLogger("rpc.server")
 
 _WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 MAX_BODY = 1_000_000
+# 429-style JSON-RPC error code for overload-limiter rejections (the
+# JSON-RPC spec reserves no code for this; the HTTP status number is
+# the conventional vocabulary and greppable in client logs).
+CODE_BUSY = 429
+# Bound on a WSClient's buffered notifications: a slow consumer loses
+# the OLDEST events (counted in rpc_ws_events_dropped_total), never
+# grows memory without limit.
+WS_EVENTS_MAX = 1024
 
 
 class RPCError(Exception):
@@ -100,15 +111,65 @@ class WSConnection:
 
 class JSONRPCServer:
     def __init__(self, routes: dict, ws_routes: dict | None = None,
-                 max_body: int = MAX_BODY):
+                 max_body: int = MAX_BODY, max_concurrent: int = 0,
+                 rate_limit_rps: float = 0.0):
         """routes: name → async fn(ctx, **params). ws_routes: extra
         routes only valid on a websocket (subscribe/unsubscribe); their
-        ctx gets .ws set."""
+        ctx gets .ws set. max_concurrent / rate_limit_rps (0 = off)
+        shed excess requests with a 429-style error instead of
+        queueing them — protecting the event loop, which also runs
+        consensus, from an RPC flood."""
         self.routes = routes
         self.ws_routes = ws_routes or {}
         self.max_body = max_body
+        self.max_concurrent = max_concurrent
+        self.rate_limit_rps = rate_limit_rps
+        self._in_flight = 0
+        self._tokens = float(max(rate_limit_rps, 1.0))
+        self._tokens_t = time.monotonic()
         self._server: asyncio.AbstractServer | None = None
         self._on_ws_close = None
+        if max_concurrent > 0:
+            CONTROLLER.register("rpc.http", lambda: self._in_flight,
+                                max_concurrent, owner=self)
+
+    # -- overload limiter --
+
+    def _admit(self) -> str | None:
+        """None to admit; otherwise the rejection reason. Concurrency
+        is checked FIRST so a request rejected for concurrency does
+        not also burn a rate token — rejected traffic must not eat
+        the budget of future legitimate requests. One token per
+        admitted request, ~1 s of burst."""
+        if 0 < self.max_concurrent <= self._in_flight:
+            return "concurrency"
+        if self.rate_limit_rps > 0:
+            now = time.monotonic()
+            # burst cap never below one whole token: a sub-1 rps limit
+            # must still admit a request every 1/rate seconds, not
+            # reject everything forever
+            self._tokens = min(
+                max(self.rate_limit_rps, 1.0),
+                self._tokens + (now - self._tokens_t)
+                * self.rate_limit_rps)
+            self._tokens_t = now
+            if self._tokens < 1.0:
+                return "rate"
+            self._tokens -= 1.0
+        return None
+
+    def _reject(self, id_, reason: str) -> dict:
+        from ..libs.metrics import rpc_metrics
+
+        rpc_metrics().requests_rejected.inc(reason=reason)
+        CONTROLLER.shed("rpc.http")
+        return _rpc_error(id_, CODE_BUSY,
+                          "server overloaded; retry later", reason)
+
+    def _gauge_in_flight(self) -> None:
+        from ..libs.metrics import rpc_metrics
+
+        rpc_metrics().requests_in_flight.set(self._in_flight)
 
     async def listen(self, host: str, port: int) -> int:
         self._server = await asyncio.start_server(self._serve_conn, host,
@@ -116,6 +177,7 @@ class JSONRPCServer:
         return self._server.sockets[0].getsockname()[1]
 
     def close(self) -> None:
+        CONTROLLER.unregister("rpc.http", owner=self)
         if self._server is not None:
             self._server.close()
 
@@ -152,8 +214,18 @@ class JSONRPCServer:
                     if not keep:
                         break
                     continue
-                resp, keep = await self._dispatch_http(method, target,
-                                                       body)
+                reason = self._admit()
+                if reason is not None:
+                    resp, keep = self._reject(None, reason), True
+                else:
+                    self._in_flight += 1
+                    self._gauge_in_flight()
+                    try:
+                        resp, keep = await self._dispatch_http(
+                            method, target, body)
+                    finally:
+                        self._in_flight -= 1
+                        self._gauge_in_flight()
                 if headers.get("connection", "").lower() == "close":
                     keep = False
                 self._write_response(writer, resp, keep)
@@ -210,7 +282,22 @@ class JSONRPCServer:
             except json.JSONDecodeError as e:
                 return _rpc_error(None, -32700, "parse error", str(e)), False
             if isinstance(req, list):
-                return [await self._call_one(r, None) for r in req], True
+                # Per-element admission: the connection handler charged
+                # ONE admission for the HTTP request, which covers the
+                # first element — every further element must pass the
+                # limiter itself, or a single 1 MB batch body would
+                # smuggle thousands of calls past the rate bucket.
+                out, first = [], True
+                for r in req:
+                    reason = None if first else self._admit()
+                    first = False
+                    if reason is not None:
+                        out.append(self._reject(
+                            r.get("id") if isinstance(r, dict) else None,
+                            reason))
+                    else:
+                        out.append(await self._call_one(r, None))
+                return out, True
             return await self._call_one(req, None), True
         if method == "GET":
             path, _, query = target.partition("?")
@@ -287,7 +374,19 @@ class JSONRPCServer:
                     continue
                 reqs = req if isinstance(req, list) else [req]
                 for r in reqs:
-                    ws.send_json(await self._call_one(r, ws))
+                    reason = self._admit()
+                    if reason is not None:
+                        ws.send_json(self._reject(
+                            r.get("id") if isinstance(r, dict) else None,
+                            reason))
+                        continue
+                    self._in_flight += 1
+                    self._gauge_in_flight()
+                    try:
+                        ws.send_json(await self._call_one(r, ws))
+                    finally:
+                        self._in_flight -= 1
+                        self._gauge_in_flight()
                 await writer.drain()
         finally:
             if self._on_ws_close is not None:
@@ -396,14 +495,25 @@ async def relay_events(ws, get_msg, drain_timeout: float = 30.0) -> None:
             return
 
 
+def _count_ws_event_drop() -> None:
+    from ..libs.metrics import rpc_metrics
+
+    rpc_metrics().ws_events_dropped.inc()
+
+
 class WSClient:
-    """Websocket JSON-RPC client with a notification queue
+    """Websocket JSON-RPC client with a BOUNDED notification queue
     (reference: rpc/jsonrpc/client/ws_client.go)."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int,
+                 events_max: int = WS_EVENTS_MAX):
         self.host = host
         self.port = port
-        self.events: asyncio.Queue = asyncio.Queue()
+        # Bounded drop-OLDEST buffer: a subscriber that stops reading
+        # loses history (counted), not the process's memory. Newest
+        # events win — they are the ones a catching-up consumer needs.
+        self.events = DropOldestQueue(events_max, queue="rpc.ws_events",
+                                      on_drop=_count_ws_event_drop)
         self._pending: dict[int, asyncio.Future] = {}
         self._id = 0
         self._task = None
@@ -473,13 +583,14 @@ class WSClient:
                     else:
                         fut.set_result(msg.get("result"))
                 else:
-                    await self.events.put(msg)
+                    self.events.put_nowait(msg)  # drop-oldest when full
         except (ConnectionError, asyncio.CancelledError):
             pass
 
     def close(self) -> None:
         if self._task is not None:
             self._task.cancel()
+        self.events.close()  # drop the overload-controller registration
         try:
             self.writer.close()
         except Exception:

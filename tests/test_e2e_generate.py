@@ -41,10 +41,14 @@ def test_space_coverage():
     assert any(vu.power == 0 for m in ms for vu in m.validator_updates)
     ops = {p.op for m in ms for p in m.perturbations}
     assert ops == {"kill", "pause", "disconnect", "disconnect_hard",
-                   "restart", "chaos"}
+                   "restart", "chaos", "overload"}
     # sampled chaos ops carry a complete, valid failpoint spec
     assert all(p.failpoint and p.action in ("error", "delay", "corrupt")
                for m in ms for p in m.perturbations if p.op == "chaos")
+    # sampled overload ops carry a delay failpoint + a positive flood
+    assert all(p.failpoint and p.action == "delay" and p.tx_rate > 0
+               for m in ms for p in m.perturbations
+               if p.op == "overload")
     assert {m.nodes for m in ms} >= {1, 2, 3, 4, 5, 6}
 
 

@@ -1,0 +1,509 @@
+"""Overload protection (libs/overload.py + the wiring across
+consensus/mempool/rpc): bounded queues, priority admission, shedding
+policy, slow-peer escalation bookkeeping, the 429-style RPC limiter,
+and the acceptance scenario — a consensus net that keeps advancing
+heights under a sustained data flood with a throttled verify path
+while shed counters climb, queue gauges stay bounded, and the /status
+overload level surfaces and then clears."""
+
+import asyncio
+import os
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.config import MempoolConfig
+from tendermint_tpu.consensus import messages as m
+from tendermint_tpu.libs import failpoints
+from tendermint_tpu.libs.metrics import overload_metrics, rpc_metrics
+from tendermint_tpu.libs.overload import (
+    CONTROLLER, DropOldestQueue, OverloadController, PriorityFunnel,
+    SlowPeerPolicy, SlowPeerTracker,
+)
+
+from helpers import make_genesis
+from test_consensus import Node, wire_network
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# --- building blocks ---------------------------------------------------------
+
+
+def test_priority_funnel_orders_and_sheds():
+    async def go():
+        f = PriorityFunnel(8, 4, "consensus.funnel.votes",
+                           "consensus.funnel.data")
+        shed0 = overload_metrics().shed.value(
+            queue="consensus.funnel.data")
+        for i in range(10):  # 6 beyond the low bound: shed, not block
+            f.put_low(("low", i))
+        assert f.low_depth() == 4
+        assert overload_metrics().shed.value(
+            queue="consensus.funnel.data") == shed0 + 6
+        await f.put_high(("high", 0))
+        # high drains FIRST even though low was queued earlier
+        assert await f.get() == ("high", 0)
+        assert await f.get() == ("low", 0)
+
+        # high class applies backpressure: put blocks until get frees
+        for i in range(8):
+            f.put_high_nowait(("high", i))
+        with pytest.raises(asyncio.QueueFull):
+            f.put_high_nowait(("high", 8))
+        blocked = asyncio.ensure_future(f.put_high(("high", 9)))
+        await asyncio.sleep(0.01)
+        assert not blocked.done()
+        assert await f.get() == ("high", 0)
+        await asyncio.wait_for(blocked, 1.0)
+        assert f.high_depth() == 8
+
+    run(go())
+
+
+def test_priority_funnel_low_class_ages_not_starves():
+    """A sustained high-class stream must not starve bulk data: after
+    LOW_SERVICE_INTERVAL consecutive high pops, a low item that
+    arrived before every queued high item is served."""
+    async def go():
+        f = PriorityFunnel(1024, 64, "consensus.funnel.votes",
+                           "consensus.funnel.data")
+        f.put_low("part")
+        for i in range(100):
+            f.put_high_nowait(("vote", i))
+        order = [await f.get()
+                 for _ in range(f.LOW_SERVICE_INTERVAL + 1)]
+        assert order[-1] == "part"
+        assert order[:-1] == [("vote", i)
+                              for i in range(f.LOW_SERVICE_INTERVAL)]
+
+    run(go())
+
+
+def test_priority_funnel_aging_never_inverts_arrival_order():
+    """Load-bearing ordering guard: a block part must NEVER be served
+    before a proposal that arrived ahead of it (consensus drops parts
+    whose PartSet does not exist yet — an aging-induced inversion
+    wedged the 4-validator net at a height forever)."""
+    async def go():
+        f = PriorityFunnel(1024, 64, "consensus.funnel.votes",
+                           "consensus.funnel.data")
+        # wind the streak far past the aging threshold
+        for i in range(f.LOW_SERVICE_INTERVAL * 2):
+            f.put_high_nowait(("vote", i))
+            await f.get()
+        assert f._high_streak >= f.LOW_SERVICE_INTERVAL
+        f.put_high_nowait("proposal")   # arrives FIRST
+        f.put_low("part")               # then its part
+        assert await f.get() == "proposal"
+        assert await f.get() == "part"
+
+    run(go())
+
+
+def test_drop_oldest_queue():
+    async def go():
+        q = DropOldestQueue(3, queue="rpc.ws_events")
+        for i in range(10):
+            q.put_nowait(i)
+        assert q.qsize() == 3 and q.dropped == 7
+        # newest survive, oldest lost
+        assert [await q.get() for _ in range(3)] == [7, 8, 9]
+
+    run(go())
+
+
+def test_slow_peer_tracker_escalation_and_recovery():
+    pol = SlowPeerPolicy(pending_bytes_hiwater=1000, skip_strikes=2,
+                         demote_strikes=3, disconnect_strikes=5)
+    tr = SlowPeerTracker(pol)
+    hi, lo = 5000, 10
+    # below high-water: nothing happens
+    assert tr.observe("p1", lo, False) is None
+    # strike sequence: skip at 2, demote at 3, disconnect at 5
+    assert tr.observe("p1", hi, False) is None
+    assert tr.observe("p1", hi, False) == "skip"
+    assert tr.level("p1") == 1
+    assert tr.observe("p1", hi, False) == "demote"
+    assert tr.level("p1") == 2
+    assert tr.observe("p1", hi, False) is None
+    assert tr.observe("p1", hi, False) == "disconnect"
+    assert tr.level("p1") == 0  # forgotten after disconnect
+
+    # a persistent peer parks at demote, never disconnects
+    for _ in range(3):
+        tr.observe("p2", hi, True)
+    for _ in range(20):
+        assert tr.observe("p2", hi, True) is None
+    assert tr.level("p2") == 2
+    # one healthy scan clears strikes and recovers the peer
+    assert tr.observe("p2", lo, True) == "recover"
+    assert tr.level("p2") == 0
+
+
+def test_controller_levels_and_gauges():
+    c = OverloadController(shed_window_s=0.05)
+    depth = {"n": 0}
+    c.register("mempool.pool", lambda: depth["n"], 100)
+    snap = c.evaluate()
+    assert snap["level"] == "ok"
+    depth["n"] = 80
+    assert c.evaluate()["level"] == "pressured"
+    depth["n"] = 99
+    snap = c.evaluate()
+    assert snap["level"] == "shedding"
+    assert snap["queues"]["mempool.pool"]["depth"] == 99
+    depth["n"] = 10
+    c.shed("mempool.pool", 3)
+    assert c.evaluate()["level"] == "shedding"  # recent-shed window
+
+    async def settle():
+        await asyncio.sleep(0.1)
+
+    run(settle())
+    assert c.evaluate()["level"] == "ok"  # clears after the window
+    # gauges reflect the LAST evaluate
+    assert overload_metrics().queue_depth.value(
+        queue="mempool.pool") == 10
+    # a depth fn that raises reads as empty, never propagates
+    c.register("mempool.pool", lambda: 1 / 0, 100)
+    assert c.evaluate()["level"] == "ok"
+
+
+# --- consensus admission -----------------------------------------------------
+
+
+async def _make_unstarted_cs(gdoc, pv):
+    """A fully wired ConsensusState WITHOUT its tasks running, so
+    admission paths can be driven synchronously."""
+    from tendermint_tpu.abci.client import ClientCreator
+    from tendermint_tpu.abci.kvstore import PersistentKVStoreApp
+    from tendermint_tpu.config import fast_consensus_config
+    from tendermint_tpu.consensus.replay import handshake_and_load_state
+    from tendermint_tpu.consensus.state import ConsensusState
+    from tendermint_tpu.libs.db import MemDB
+    from tendermint_tpu.proxy import AppConns
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.state.store import Store
+    from tendermint_tpu.store import BlockStore
+
+    conns = AppConns(ClientCreator(app=PersistentKVStoreApp(MemDB())))
+    await conns.start()
+    state_store = Store(MemDB())
+    block_store = BlockStore(MemDB())
+    state = await handshake_and_load_state(
+        None, state_store, block_store, gdoc, conns)
+    executor = BlockExecutor(state_store, conns.consensus)
+    cs = ConsensusState(fast_consensus_config(), state, executor,
+                        block_store)
+    if pv is not None:
+        cs.set_priv_validator(pv)
+    return cs, conns
+
+
+def _prevote(cs, gdoc, pvs, pv_idx):
+    from tendermint_tpu.types.vote import Vote, VoteType
+
+    pv = pvs[pv_idx]
+    addr = pv.get_pub_key().address()
+    idx, _ = cs.rs.validators.get_by_address(addr)
+    return Vote(type=VoteType.PREVOTE, height=cs.rs.height, round=0,
+                block_id=None, timestamp=1_700_000_001_000_000_000,
+                validator_address=addr, validator_index=idx)
+
+
+def test_vote_buf_bound_sheds_not_blocks():
+    async def go():
+        gdoc, pvs = make_genesis(4)
+        cs, conns = await _make_unstarted_cs(gdoc, pvs[0])
+        try:
+            cs.config.vote_buf_max = 2
+            shed0 = overload_metrics().shed.value(
+                queue="consensus.vote_buf")
+            for i in range(4):
+                assert cs._enqueue_vote(_prevote(cs, gdoc, pvs, i % 4),
+                                        f"p{i}")
+            assert len(cs._vote_buf) == 2
+            assert overload_metrics().shed.value(
+                queue="consensus.vote_buf") == shed0 + 2
+        finally:
+            await conns.stop()
+
+    run(go())
+
+
+def test_duplicate_votes_shed_first_under_pressure():
+    async def go():
+        gdoc, pvs = make_genesis(4)
+        cs, conns = await _make_unstarted_cs(gdoc, pvs[0])
+        try:
+            vote = _prevote(cs, gdoc, pvs, 1)
+
+            class DupSet:
+                def is_duplicate(self, v):
+                    return True
+
+            cs._target_vote_set = lambda v: DupSet()
+            # not pressured: the duplicate is admitted (normal path
+            # stays probe-free; dedup happens in the scheduler)
+            cs.add_peer_msg_nowait(m.VoteMessage(vote), "pX")
+            assert cs.peer_funnel.high_depth() == 1
+            # pressure the funnel: duplicates now shed at admission
+            cs.peer_funnel._low.extend(
+                range(cs.config.peer_funnel_data_size))
+            shed0 = overload_metrics().shed.value(
+                queue="consensus.funnel.votes")
+            cs.add_peer_msg_nowait(m.VoteMessage(vote), "pX")
+            assert cs.peer_funnel.high_depth() == 1  # not admitted
+            assert overload_metrics().shed.value(
+                queue="consensus.funnel.votes") == shed0 + 1
+        finally:
+            await conns.stop()
+
+    run(go())
+
+
+# --- mempool / RPC admission -------------------------------------------------
+
+
+class _FakeAppClient:
+    def __init__(self, in_flight=0):
+        self._n = in_flight
+
+    def in_flight(self):
+        return self._n
+
+    async def check_tx(self, req):
+        return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK)
+
+
+def test_mempool_busy_admission():
+    from tendermint_tpu.mempool.clist_mempool import (
+        CListMempool, MempoolBusyError,
+    )
+
+    async def go():
+        cfg = MempoolConfig(checktx_max_inflight=4)
+        mp = CListMempool(cfg, _FakeAppClient(in_flight=10))
+        assert mp.overloaded()
+        with pytest.raises(MempoolBusyError):
+            await mp.check_tx(b"k=v")
+        assert mp.size() == 0
+
+        ok = CListMempool(cfg, _FakeAppClient(in_flight=0))
+        assert not ok.overloaded()
+        res = await ok.check_tx(b"k=v")
+        assert res.code == abci.CODE_TYPE_OK and ok.size() == 1
+
+    run(go())
+
+
+def test_rpc_limiter_concurrency_and_rate():
+    from tendermint_tpu.rpc.jsonrpc import (
+        CODE_BUSY, HTTPClient, JSONRPCServer, RPCError,
+    )
+
+    async def go():
+        gate = asyncio.Event()
+
+        async def slow(ctx):
+            await gate.wait()
+            return {"ok": True}
+
+        srv = JSONRPCServer({"slow": slow}, max_concurrent=1)
+        port = await srv.listen("127.0.0.1", 0)
+        try:
+            c1 = HTTPClient("127.0.0.1", port)
+            c2 = HTTPClient("127.0.0.1", port)
+            t1 = asyncio.ensure_future(c1.call("slow"))
+            await asyncio.sleep(0.1)  # t1 occupies the one slot
+            with pytest.raises(RPCError) as ei:
+                await c2.call("slow")
+            assert ei.value.code == CODE_BUSY
+            rejected = rpc_metrics().requests_rejected.value(
+                reason="concurrency")
+            assert rejected >= 1
+            gate.set()
+            assert (await t1) == {"ok": True}
+        finally:
+            srv.close()
+
+        # token bucket: 1 rps with ~1-token burst -> second immediate
+        # request sheds with reason "rate"
+        srv = JSONRPCServer({"slow": slow}, rate_limit_rps=1.0)
+        gate.set()
+        port = await srv.listen("127.0.0.1", 0)
+        try:
+            c = HTTPClient("127.0.0.1", port)
+            assert await c.call("slow") == {"ok": True}
+            with pytest.raises(RPCError) as ei:
+                await HTTPClient("127.0.0.1", port).call("slow")
+            assert ei.value.code == CODE_BUSY
+        finally:
+            srv.close()
+
+    run(go())
+
+
+def test_ws_client_event_queue_bounded():
+    from tendermint_tpu.rpc.jsonrpc import WSClient
+
+    ws = WSClient("127.0.0.1", 1, events_max=5)
+    drop0 = rpc_metrics().ws_events_dropped.value()
+    for i in range(50):
+        ws.events.put_nowait({"i": i})
+    assert ws.events.qsize() == 5
+    assert rpc_metrics().ws_events_dropped.value() == drop0 + 45
+
+
+# --- FileDB torn-tail quarantine (satellite) --------------------------------
+
+
+def test_filedb_quarantines_torn_tail(tmp_path):
+    from tendermint_tpu.libs.db import FileDB
+
+    path = str(tmp_path / "kv.db")
+    db = FileDB(path)
+    db.set(b"good", b"data")
+    db.close()
+    garbage = b"\xde\xad\xbe\xef\xff\xff"
+    with open(path, "ab") as f:
+        f.write(garbage)
+    db2 = FileDB(path)
+    assert db2.get(b"good") == b"data"
+    # the torn bytes were QUARANTINED, not destroyed
+    q = path + ".corrupt.000"
+    assert os.path.exists(q)
+    with open(q, "rb") as f:
+        assert f.read() == garbage
+    db2.close()
+    # a second crash quarantines to the NEXT slot
+    with open(path, "ab") as f:
+        f.write(b"\x01\x02\x03")
+    FileDB(path).close()
+    assert os.path.exists(path + ".corrupt.001")
+
+
+# --- lint (satellite) --------------------------------------------------------
+
+
+def test_check_backpressure_lint():
+    from tools.check_backpressure import collect_problems
+
+    problems = collect_problems()
+    assert problems == [], "\n".join(problems)
+
+
+# --- the acceptance scenario -------------------------------------------------
+
+
+def test_net_advances_under_flood_with_throttled_verify():
+    """ISSUE 4 acceptance: under a sustained data flood into the
+    consensus funnel WITH an injected device.verify delay, heights
+    advance monotonically, at least one *_shed_total counter is
+    non-zero, no queue-depth gauge exceeds its configured bound, and
+    the overload level surfaces in /status — then clears after the
+    flood stops."""
+    from tendermint_tpu.libs.debugsrv import HealthMonitor
+    from tendermint_tpu.libs.metrics import consensus_metrics
+
+    async def go():
+        gdoc, pvs = make_genesis(4)
+        nodes = [Node(gdoc, pv) for pv in pvs]
+        for n in nodes:
+            await n.start()
+        wire_network(nodes)
+        old_window = CONTROLLER.shed_window_s
+        CONTROLLER.shed_window_s = 1.0
+        flood = None
+        try:
+            await nodes[0].cs.wait_for_height(1, timeout=60)
+            failpoints.arm("device.verify", "delay", delay_ms=5.0)
+
+            # flood payload: real bytes of the committed block 1,
+            # replayed as STALE parts — decodable bulk data on the
+            # low-priority class
+            part = nodes[0].cs.block_store.load_block_part(1, 0)
+            assert part is not None
+            stale = m.BlockPartMessage(height=1, round=0, part=part)
+
+            cs0 = nodes[0].cs
+            cap = cs0.config.peer_funnel_data_size
+            statuses, max_heights = [], []
+
+            async def flood_loop():
+                while True:
+                    # burst well past the bound, synchronously — the
+                    # overflow MUST shed, and depth must stay bounded.
+                    # Bursts leave drain gaps: unlike real p2p gossip,
+                    # wire_network never re-sends a shed part, so a
+                    # flood that pins the queue at cap forever would
+                    # starve the ONE copy of each real part — an
+                    # artifact of the lossless test wiring, not of the
+                    # product (gossip_data_routine re-sends missing
+                    # parts until the peer has them).
+                    for _ in range(cap + 200):
+                        cs0.add_peer_msg_nowait(stale, "flooder")
+                    snap = CONTROLLER.evaluate()
+                    assert snap["queues"]["consensus.funnel.data"][
+                        "depth"] <= cap
+                    statuses.append(snap["level"])
+                    await asyncio.sleep(0.25)
+
+            flood = asyncio.get_event_loop().create_task(flood_loop())
+            h0_start = cs0.rs.height
+            target = h0_start + 3
+            for _ in range(1200):
+                max_heights.append(max(n.cs.rs.height for n in nodes))
+                if max_heights[-1] >= target and \
+                        cs0.rs.height > h0_start:
+                    break
+                await asyncio.sleep(0.05)
+            # liveness: consensus keeps committing through the flood,
+            # and the FLOODED node itself advances under load (full
+            # lockstep would need gossip re-send, which the lossless
+            # wire_network deliberately lacks — see flood_loop note)
+            assert max_heights[-1] >= target, \
+                [(n.cs.rs.height, n.cs.rs.round) for n in nodes]
+            assert cs0.rs.height > h0_start, \
+                (cs0.rs.height, h0_start)
+            # monotonic height progression
+            assert all(b >= a for a, b in zip(max_heights,
+                                              max_heights[1:]))
+            # shedding happened and is counted
+            assert overload_metrics().shed.value(
+                queue="consensus.funnel.data") > 0
+            # the overload level surfaced (shedding under the bursts)
+            assert "shedding" in statuses
+            # ... and /status carries it as a degraded (not failing)
+            # overload check
+            st = HealthMonitor().status()
+            assert st["checks"]["overload"]["status"] in ("ok",
+                                                          "degraded")
+
+            flood.cancel()
+            flood = None
+            failpoints.disarm_all()
+            # recovery: the level clears once the flood stops
+            cleared = False
+            for _ in range(100):
+                await asyncio.sleep(0.1)
+                if CONTROLLER.evaluate()["level"] == "ok":
+                    cleared = True
+                    break
+            assert cleared, CONTROLLER.evaluate()
+            st = HealthMonitor().status()
+            assert st["checks"]["overload"]["level"] == "ok"
+            # the height gauge kept pace (metrics parity under load)
+            assert consensus_metrics().height.value() >= target - 1
+        finally:
+            if flood is not None:
+                flood.cancel()
+            failpoints.disarm_all()
+            CONTROLLER.shed_window_s = old_window
+            for n in nodes:
+                await n.stop()
+
+    run(go())

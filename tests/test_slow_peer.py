@@ -1,0 +1,121 @@
+"""Slow-peer escalation through the real Switch scan path: strike
+accumulation from pending_send_bytes, gossip-pause levels on the Peer,
+eviction of non-persistent offenders, persistent peers parked at
+demote, and recovery. (The pure tracker logic is covered dependency-
+free in tests/test_overload.py; this exercises the switch glue, which
+imports the p2p stack.)"""
+
+import asyncio
+
+import pytest
+
+pytest.importorskip("cryptography")
+
+from tendermint_tpu.libs.metrics import p2p_metrics
+from tendermint_tpu.libs.overload import SlowPeerPolicy
+from tendermint_tpu.p2p.switch import Switch
+
+
+class _FakeMConn:
+    def __init__(self):
+        self.pending = 0
+        self.channels = {}
+
+    def pending_send_bytes(self):
+        return self.pending
+
+    def send_rate(self):
+        return 0.0
+
+
+class _FakePeer:
+    def __init__(self, pid, persistent=False):
+        self.id = pid
+        self.persistent = persistent
+        self.outbound = True
+        self.socket_addr = ""
+        self.slow_level = 0
+        self.mconn = _FakeMConn()
+        self.stopped = False
+
+    def is_persistent(self):
+        return self.persistent
+
+    def pending_send_bytes(self):
+        return self.mconn.pending_send_bytes()
+
+    def send_rate(self):
+        return self.mconn.send_rate()
+
+    async def start(self):
+        pass
+
+    async def stop(self):
+        self.stopped = True
+
+    def __repr__(self):
+        return f"FakePeer({self.id})"
+
+
+class _FakeTransport:
+    async def close(self):
+        pass
+
+
+def _switch():
+    return Switch(
+        _FakeTransport(), lambda: None,
+        slow_peer_policy=SlowPeerPolicy(
+            pending_bytes_hiwater=1000, skip_strikes=1,
+            demote_strikes=2, disconnect_strikes=3))
+
+
+def test_scan_escalates_and_evicts_non_persistent():
+    async def go():
+        sw = _switch()
+        peer = _FakePeer("aa" * 20)
+        sw.peers[peer.id] = peer
+        peer.mconn.pending = 5000
+
+        ev0 = p2p_metrics().slow_peer_events.value(action="disconnect")
+        assert await sw._scan_slow_peers() == [(peer.id, "skip")]
+        assert peer.slow_level == 1
+        assert await sw._scan_slow_peers() == [(peer.id, "demote")]
+        assert peer.slow_level == 2
+        assert await sw._scan_slow_peers() == [(peer.id, "disconnect")]
+        assert peer.stopped and peer.id not in sw.peers
+        assert p2p_metrics().slow_peer_events.value(
+            action="disconnect") == ev0 + 1
+
+    asyncio.run(go())
+
+
+def test_persistent_peer_parks_at_demote_then_recovers():
+    async def go():
+        sw = _switch()
+        peer = _FakePeer("bb" * 20, persistent=True)
+        sw.peers[peer.id] = peer
+        peer.mconn.pending = 5000
+        for _ in range(6):
+            await sw._scan_slow_peers()
+        assert not peer.stopped and peer.id in sw.peers
+        assert peer.slow_level == 2
+        # backlog drains: one healthy scan restores full gossip
+        peer.mconn.pending = 0
+        assert await sw._scan_slow_peers() == [(peer.id, "recover")]
+        assert peer.slow_level == 0
+
+    asyncio.run(go())
+
+
+def test_healthy_peer_untouched():
+    async def go():
+        sw = _switch()
+        peer = _FakePeer("cc" * 20)
+        sw.peers[peer.id] = peer
+        peer.mconn.pending = 10
+        for _ in range(5):
+            assert await sw._scan_slow_peers() == []
+        assert peer.slow_level == 0 and not peer.stopped
+
+    asyncio.run(go())

@@ -1,0 +1,122 @@
+"""Backpressure lint (invoked from the test suite, like
+tools/check_metrics.py and check_failpoints.py).
+
+Keeps the overload-protection surface honest as bounded queues spread:
+
+1. The overload metric family exists and has the canonical members
+   (level / queue_depth / queue_capacity / shed_total) — every tracked
+   queue exports a depth gauge and a shed counter through them.
+2. The QUEUES catalog in libs/overload.py is CLOSED and live: every
+   name has at least one product call site (a register()/shed()/
+   PriorityFunnel/DropOldestQueue reference), and every queue-name
+   string used at those call sites is in the catalog — no ad-hoc queue
+   names minting unbounded, uninstrumented series.
+3. docs/OBSERVABILITY.md documents every tracked queue (the "Tracked
+   bounded queues" table) and documents no queue that does not exist.
+
+Run directly (`python tools/check_backpressure.py`) for a report +
+exit code, or via tests/test_overload.py which calls collect_problems.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(REPO, "tendermint_tpu")
+DOCS = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+
+# Calls that take a tracked-queue name as a string argument / kwarg.
+_CALL_RE = re.compile(
+    r"""(?:\.register\(\s*|\.shed\(\s*|high_queue\s*=\s*|"""
+    r"""low_queue\s*=\s*|queue\s*=\s*)"([a-z0-9_.]+)"  """.strip())
+
+
+def _product_sources() -> list[tuple[str, str]]:
+    out = []
+    for root, _dirs, files in os.walk(SRC):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            with open(path, encoding="utf-8") as f:
+                out.append((os.path.relpath(path, REPO), f.read()))
+    return out
+
+
+def collect_problems() -> list[str]:
+    sys.path.insert(0, REPO)
+    from tendermint_tpu.libs import overload
+    from tendermint_tpu.libs.metrics import all_module_metrics
+
+    problems: list[str] = []
+
+    # 1. metric surface: depth gauge + shed counter exist
+    declared = all_module_metrics()
+    for name in ("overload_level", "overload_queue_depth",
+                 "overload_queue_capacity", "overload_shed_total"):
+        if name not in declared:
+            problems.append(
+                f"{name}: missing from the libs/metrics.py catalog — "
+                "tracked queues cannot export depth/shed without it")
+
+    # 2. catalog <-> call sites
+    used: dict[str, list[str]] = {}
+    for rel, text in _product_sources():
+        if rel.endswith("libs/overload.py"):
+            continue  # the catalog itself
+        for m in _CALL_RE.finditer(text):
+            used.setdefault(m.group(1), []).append(rel)
+    for q in overload.QUEUES:
+        if q not in used:
+            problems.append(
+                f"{q}: in the QUEUES catalog but never registered or "
+                "shed by any product call site")
+    for q, sites in sorted(used.items()):
+        if q not in overload.QUEUES:
+            problems.append(
+                f"{q}: queue name used at {sorted(set(sites))} but not "
+                "in the libs/overload.py QUEUES catalog")
+
+    # 3. docs table sync
+    if not os.path.exists(DOCS):
+        problems.append(f"{DOCS}: missing")
+        return problems
+    with open(DOCS, encoding="utf-8") as f:
+        docs = f.read()
+    m = re.search(r"^### Tracked bounded queues$(.*?)(?=^#)", docs,
+                  re.M | re.S)
+    if m is None:
+        problems.append(
+            "docs/OBSERVABILITY.md: no '### Tracked bounded queues' "
+            "section")
+        return problems
+    documented = set(re.findall(r"^\|\s*`([a-z0-9_.]+)`\s*\|",
+                                m.group(1), re.M))
+    for q in overload.QUEUES:
+        if q not in documented:
+            problems.append(
+                f"{q}: tracked queue missing from the "
+                "docs/OBSERVABILITY.md 'Tracked bounded queues' table")
+    for q in sorted(documented - set(overload.QUEUES)):
+        problems.append(
+            f"{q}: documented as a tracked queue but not in the "
+            "libs/overload.py QUEUES catalog")
+    return problems
+
+
+def main() -> int:
+    problems = collect_problems()
+    for p in problems:
+        print(f"LINT: {p}")
+    from tendermint_tpu.libs import overload
+
+    print(f"{len(overload.QUEUES)} tracked bounded queues")
+    print("OK" if not problems else "FAILED")
+    return 0 if not problems else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

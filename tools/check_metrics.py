@@ -34,7 +34,8 @@ DOCS = os.path.join(REPO, "docs", "OBSERVABILITY.md")
 # docs/nodes/metrics.md module list.
 NAMESPACES = {
     "consensus", "crypto", "p2p", "mempool", "blockchain", "statesync",
-    "evidence", "state", "abci", "tpu", "tracing", "failpoint",
+    "evidence", "state", "abci", "tpu", "tracing", "failpoint", "rpc",
+    "overload",
 }
 
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")

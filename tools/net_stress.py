@@ -10,6 +10,15 @@ unlike the e2e subprocess runner.
 
     python tools/net_stress.py [--runs 100] [--misbehavior double-propose]
                                [--target-height 4] [--stall 25]
+
+--overload turns each run into the overload driver behind the e2e
+`overload` perturbation (docs/CHAOS.md runbook): a device.verify delay
+failpoint throttles verification while a gossip flood (stale block
+parts via tx_flood, the same pacing loop the e2e runner uses) hammers
+node0's consensus funnel — the net must still reach the target height
+with shed counters climbing and every tracked queue inside its bound.
+
+    python tools/net_stress.py --overload [--runs 20] [--flood-rate 500]
 """
 
 import asyncio
@@ -45,13 +54,36 @@ def _dump(nodes) -> None:
 
 
 async def one(i: int, misbehavior: str, target_h: int,
-              stall_s: float) -> bool:
+              stall_s: float, overload: bool = False,
+              flood_rate: float = 500.0) -> bool:
     from p2p_harness import make_net
 
     from tendermint_tpu.consensus.misbehavior import MISBEHAVIORS
 
     nodes = await make_net(4)
+    flood_task = None
     try:
+        if overload:
+            from tendermint_tpu.consensus import messages as cm
+            from tendermint_tpu.crypto import merkle
+            from tendermint_tpu.e2e.runner import tx_flood
+            from tendermint_tpu.libs import failpoints
+            from tendermint_tpu.types.block import Part
+
+            failpoints.arm("device.verify", "delay", delay_ms=10.0)
+            # stale-height block parts: decodable, cheap to reject,
+            # and exactly the bulk-data class the funnel must shed
+            # without starving votes
+            _root, proofs = merkle.proofs_from_byte_slices([b"x" * 256])
+            part_msg = cm.BlockPartMessage(
+                height=1, round=0,
+                part=Part(0, b"x" * 256, proofs[0]))
+
+            async def submit(_tx: bytes) -> None:
+                nodes[0].cs.add_peer_msg_nowait(part_msg, "flooder")
+
+            flood_task = asyncio.get_event_loop().create_task(
+                tx_flood(submit, flood_rate, stall_s * 2))
         if misbehavior:
             # Stay inside the f=1 byzantine bound: PROPOSER-triggered
             # misbehaviors (double-propose) fire only on the height-2
@@ -80,6 +112,16 @@ async def one(i: int, misbehavior: str, target_h: int,
                 return False
             await asyncio.sleep(0.1)
     finally:
+        if flood_task is not None:
+            flood_task.cancel()
+            from tendermint_tpu.libs import failpoints
+            from tendermint_tpu.libs.metrics import overload_metrics
+
+            failpoints.disarm_all()
+            shed = overload_metrics().shed.value(
+                queue="consensus.funnel.data")
+            print(f"  run {i}: funnel.data shed so far {shed:.0f}",
+                  flush=True)
         for n in nodes:
             try:
                 await n.stop()
@@ -89,6 +131,7 @@ async def one(i: int, misbehavior: str, target_h: int,
 
 async def main() -> int:
     runs, mis, target_h, stall = 100, "", 4, 25.0
+    overload, flood_rate = False, 500.0
     args = sys.argv
     for i, a in enumerate(args):
         if a == "--runs":
@@ -99,18 +142,23 @@ async def main() -> int:
             target_h = int(args[i + 1])
         elif a == "--stall":
             stall = float(args[i + 1])
+        elif a == "--overload":
+            overload = True
+        elif a == "--flood-rate":
+            flood_rate = float(args[i + 1])
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     wedges = 0
     t0 = time.monotonic()
     for i in range(runs):
-        if not await one(i, mis, target_h, stall):
+        if not await one(i, mis, target_h, stall, overload=overload,
+                         flood_rate=flood_rate):
             wedges += 1
         if (i + 1) % 25 == 0:
             print(f"progress: {i + 1}/{runs}, {wedges} wedges, "
                   f"{time.monotonic() - t0:.0f}s", flush=True)
-    label = mis or "clean"
+    label = "overload" if overload else (mis or "clean")
     print(f"net_stress [{label}]: {wedges} wedges / {runs} runs")
     return 1 if wedges else 0
 
