@@ -21,6 +21,11 @@ class BaseConfig:
     # sqlite (ordered, disk-resident, range deletes — the tm-db
     # analogue) | filedb (log-structured, memory-resident) | memdb
     db_backend: str = "sqlite"
+    # sqlite durability (PRAGMA synchronous): FULL fsyncs every
+    # committed batch — the contract the crash-recovery sweep proves.
+    # NORMAL/OFF trade the tail of the log for write speed; only safe
+    # for replayable non-validator workloads (libs/db.py SqliteDB).
+    db_synchronous: str = "FULL"
     genesis_file: str = "config/genesis.json"
     priv_validator_key_file: str = "config/priv_validator_key.json"
     priv_validator_state_file: str = "data/priv_validator_state.json"
@@ -50,6 +55,14 @@ class BaseConfig:
 
     def resolve(self, path: str) -> str:
         return path if os.path.isabs(path) else os.path.join(self.home, path)
+
+    def validate_basic(self) -> None:
+        if self.db_backend not in ("sqlite", "filedb", "memdb"):
+            raise ValueError(f"unknown db_backend {self.db_backend!r}")
+        if self.db_synchronous.upper() not in ("OFF", "NORMAL", "FULL"):
+            raise ValueError(
+                f"db_synchronous must be OFF|NORMAL|FULL, "
+                f"not {self.db_synchronous!r}")
 
 
 @dataclass
@@ -311,6 +324,7 @@ class Config:
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
 
     def validate_basic(self) -> None:
+        self.base.validate_basic()
         self.rpc.validate_basic()
         self.p2p.validate_basic()
         self.mempool.validate_basic()

@@ -1,12 +1,25 @@
-"""Handshake block-replay (reference: consensus/replay.go:201-420).
+"""Handshake block-replay + startup reconciliation (reference:
+consensus/replay.go:201-420).
 
 On boot, reconcile three heights: the app's (ABCI Info), the state
 store's, and the block store's. The app may be behind (crashed before
 Commit) — replay stored blocks into it; tendermint state may be one
 behind the block store (crashed between SaveBlock and ApplyBlock) —
-re-apply the last block through the full executor path."""
+re-apply the last block through the full executor path.
+
+The Handshaker doubles as an explicit RECONCILER: every legal
+cross-store skew a commit-pipeline crash can leave (see
+libs/failpoints.py COMMIT_PIPELINE and the docs/CHAOS.md
+"Crash-recovery runbook") is enumerated, healed, and recorded in a
+RecoveryReport — each repair named from the closed REPAIR_KINDS
+catalog, counted in the `recovery` metrics namespace, and surfaced in
+GET /status for the life of the process."""
 
 from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, field
 
 from ..abci import types as abci_t
 from ..abci.client import Client
@@ -19,9 +32,76 @@ from ..state.store import Store
 from ..store import BlockStore
 from ..types.genesis import GenesisDoc
 
+logger = logging.getLogger("consensus.replay")
+
 
 class HandshakeError(Exception):
     pass
+
+
+# The closed catalog of startup repairs. tools/check_recovery.py lints
+# that every kind is documented in the docs/CHAOS.md runbook table and
+# produced by at least one record() call site.
+REPAIR_KINDS: dict[str, str] = {
+    "wal_torn_tail":
+        "corrupt consensus-WAL head tail quarantined and truncated "
+        "(crash mid-append)",
+    "app_replay":
+        "app behind the block store: stored blocks re-executed into "
+        "the app, exec-only (crash before the app's Commit)",
+    "state_reapply":
+        "tendermint state one behind the block store: the last stored "
+        "block re-applied through the full executor path (crash "
+        "between save_block and apply_block)",
+    "state_from_responses":
+        "state behind an app that already committed: state brought "
+        "forward from the saved ABCI responses without re-executing "
+        "(crash between app Commit and the state save)",
+}
+
+
+@dataclass
+class RecoveryReport:
+    """What the startup reconciler found and did — kept on the Node
+    (`node.recovery_report`) and rendered by the /status `recovery`
+    check so the last boot's crash-recovery story is inspectable on a
+    live validator, not just greppable from logs."""
+
+    app_height: int = 0
+    state_height: int = 0
+    store_height: int = 0
+    wal_end_height: int | None = None
+    wal_tail_repaired_bytes: int = 0
+    quarantined_files: list[str] = field(default_factory=list)
+    repairs: list[dict] = field(default_factory=list)
+    blocks_replayed: int = 0
+
+    def record(self, kind: str, detail: str = "", blocks: int = 0) -> None:
+        assert kind in REPAIR_KINDS, kind
+        self.repairs.append({"kind": kind, "detail": detail})
+        self.blocks_replayed += blocks
+        logger.warning("startup recovery: %s — %s", kind, detail)
+        try:
+            from ..libs.metrics import recovery_metrics
+
+            m = recovery_metrics()
+            m.repairs.inc(kind=kind)
+            if blocks:
+                m.blocks_replayed.inc(blocks)
+        except Exception:  # metrics must never block recovery
+            logger.exception("recovery metrics update failed")
+
+    def to_dict(self) -> dict:
+        return {
+            "app_height": self.app_height,
+            "state_height": self.state_height,
+            "store_height": self.store_height,
+            "wal_end_height": self.wal_end_height,
+            "wal_tail_repaired_bytes": self.wal_tail_repaired_bytes,
+            "quarantined_files": list(self.quarantined_files),
+            "repairs": list(self.repairs),
+            "blocks_replayed": self.blocks_replayed,
+        }
 
 
 class _MockReplayClient(Client):
@@ -57,13 +137,14 @@ class _MockReplayClient(Client):
 class Handshaker:
     def __init__(self, state_store: Store, state: SmState,
                  block_store: BlockStore, genesis_doc: GenesisDoc,
-                 event_bus=None):
+                 event_bus=None, report: RecoveryReport | None = None):
         self.state_store = state_store
         self.initial_state = state
         self.block_store = block_store
         self.genesis_doc = genesis_doc
         self.event_bus = event_bus
         self.n_blocks_replayed = 0
+        self.report = report if report is not None else RecoveryReport()
 
     async def handshake(self, app_conns) -> bytes:
         """Returns the app hash both sides agree on after replay."""
@@ -87,6 +168,10 @@ class Handshaker:
         """reference replay.go:285 replayBlocks — all height cases."""
         store_height = self.block_store.height
         state_height = state.last_block_height
+        rep = self.report
+        rep.app_height = app_height
+        rep.state_height = state_height
+        rep.store_height = store_height
 
         # genesis: app has never seen InitChain
         if app_height == 0 and state_height == 0:
@@ -147,6 +232,12 @@ class Handshaker:
         for h in range(first, exec_until + 1):
             app_hash = await self._exec_block(h, app_conns)
             self.n_blocks_replayed += 1
+        if exec_until >= first:
+            rep.record(
+                "app_replay",
+                f"re-executed stored blocks {first}..{exec_until} into "
+                f"the app (app was at {app_height})",
+                blocks=exec_until - first + 1)
 
         if full_apply_last:
             block = self.block_store.load_block(store_height)
@@ -156,6 +247,11 @@ class Handshaker:
             if store_height >= first:
                 # app is also missing this block: full apply drives it
                 client = app_conns.consensus
+                rep.record(
+                    "state_reapply",
+                    f"re-applied block {store_height} through the full "
+                    f"executor path (state was at {state_height})",
+                    blocks=1)
             else:
                 # app already committed it (crash between app Commit and
                 # state save) — bring ONLY tendermint state forward, via
@@ -165,6 +261,11 @@ class Handshaker:
                     self.state_store.load_abci_responses(store_height),
                     app_hash,
                 )
+                rep.record(
+                    "state_from_responses",
+                    f"rebuilt state for block {store_height} from saved "
+                    f"ABCI responses (app already committed it)",
+                    blocks=1)
             executor = BlockExecutor(self.state_store, client,
                                      event_bus=self.event_bus)
             new_state, _ = await executor.apply_block(
@@ -209,16 +310,108 @@ class Handshaker:
             )
 
 
+def _reconcile_wal(wal_path: str, report: RecoveryReport) -> None:
+    """Pre-handshake WAL reconciliation: quarantine+truncate a torn
+    head tail (so consensus catchup replays a clean record sequence)
+    and note the newest committed-height marker for the report. The
+    consensus loop re-opens the WAL for append later; repair() here is
+    idempotent — a clean head is a no-op."""
+    from .wal import WAL, EndHeightMessage
+
+    if not os.path.exists(wal_path):
+        return
+    w = WAL(wal_path)
+    try:
+        # ONE decode pass serves both the torn-tail check and the
+        # end-height scan (a boot-time WAL head can be 10 MB; decoding
+        # it once per question adds up).
+        msgs, consumed, size = WAL._decode_file(wal_path)
+        torn = size - consumed
+        if torn > 0 and w.repair():
+            report.wal_tail_repaired_bytes = torn
+            report.record(
+                "wal_torn_tail",
+                f"quarantined {torn} torn tail bytes of {wal_path}")
+        end = None
+        for msg in msgs:
+            if isinstance(msg.msg, EndHeightMessage):
+                end = msg.msg.height
+        if end is None:
+            # the newest marker may sit in a rotated segment (crash
+            # right after a rotation leaves an empty/markerless head):
+            # walk older segments newest-first, stop at the first hit
+            for seg in reversed(w.segment_paths()[:-1]):
+                for msg in w._read_segment(seg):
+                    if isinstance(msg.msg, EndHeightMessage):
+                        end = msg.msg.height
+                if end is not None:
+                    break
+        report.wal_end_height = end
+    finally:
+        w.close()
+
+
+def _scan_quarantine(dirs, report: RecoveryReport) -> None:
+    """List corruption-evidence files (`*.corrupt.NNN` from FileDB
+    replay and WAL repair — including the one a _reconcile_wal call
+    just wrote) so operators see accumulated evidence in /status and
+    on the recovery_quarantined_files gauge, instead of discovering it
+    by du(1) years later."""
+    found: list[str] = []
+    for d in dict.fromkeys(d for d in dirs if d):
+        if not os.path.isdir(d):
+            continue
+        for name in sorted(os.listdir(d)):
+            if ".corrupt." in name:
+                found.append(os.path.join(d, name))
+    report.quarantined_files = found
+    try:
+        from ..libs.metrics import recovery_metrics
+
+        recovery_metrics().quarantined_files.set(len(found))
+    except Exception:
+        logger.exception("recovery metrics update failed")
+
+
+async def reconcile_and_handshake(
+    config, state_store: Store, block_store: BlockStore,
+    genesis_doc: GenesisDoc, app_conns, event_bus=None,
+    wal_path: str | None = None, scan_dirs=(),
+) -> tuple[SmState, RecoveryReport]:
+    """Full startup reconciliation: repair the WAL tail, inventory
+    quarantined evidence, load-or-genesis state, handshake the app
+    (healing every legal cross-store skew), and return the
+    post-handshake state plus the RecoveryReport describing what was
+    found and repaired (the node assembly entry point)."""
+    report = RecoveryReport()
+    _scan_quarantine(list(scan_dirs), report)
+    if wal_path:
+        _reconcile_wal(wal_path, report)
+        # the repair may have just minted a quarantine file: rescan
+        if report.wal_tail_repaired_bytes:
+            _scan_quarantine(list(scan_dirs), report)
+    state = state_store.load()
+    if state is None:
+        state = make_genesis_state(genesis_doc)
+        state_store.save(state)
+    h = Handshaker(state_store, state, block_store, genesis_doc,
+                   event_bus, report=report)
+    await h.handshake(app_conns)
+    # report.{app,state,store}_height stay as replay_blocks recorded
+    # them PRE-repair — /status documents them as the skew the boot
+    # recovered from, not the healed values.
+    state = state_store.load() or state
+    return state, report
+
+
 async def handshake_and_load_state(
     config, state_store: Store, block_store: BlockStore,
     genesis_doc: GenesisDoc, app_conns, event_bus=None,
 ) -> SmState:
     """Load-or-genesis state, handshake the app, return the
-    post-handshake state (the node assembly entry point)."""
-    state = state_store.load()
-    if state is None:
-        state = make_genesis_state(genesis_doc)
-        state_store.save(state)
-    h = Handshaker(state_store, state, block_store, genesis_doc, event_bus)
-    await h.handshake(app_conns)
-    return state_store.load() or state
+    post-handshake state (compatibility wrapper around
+    reconcile_and_handshake for callers that don't keep the report)."""
+    state, _ = await reconcile_and_handshake(
+        config, state_store, block_store, genesis_doc, app_conns,
+        event_bus)
+    return state

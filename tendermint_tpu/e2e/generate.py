@@ -68,11 +68,23 @@ def generate(rng: random.Random) -> Manifest:
         ("wal.fsync", "delay"), ("db.set", "delay"),
         ("abci.deliver", "delay"), ("device.verify", "error"),
     )
+    # kill-at-named-point rotation: commit-pipeline boundaries whose
+    # crash/restart recovery the sweep proves (tools/crash_sweep.py);
+    # here they run against a LIVE net with peers and load
+    kill_points = (
+        "consensus.commit.block_saved", "state.apply.app_committed",
+        "store.save_block", "wal.fsync",
+    ) + (("privval.save",) if privval == "file" else ())
+    # privval.save only with local keys: a remote-signer node never
+    # hits the point in-process (the runner would fall back to SIGKILL
+    # and silently skip the dimension)
     for i in range(perturbable):
         if rng.random() < 0.35:
             op = rng.choice(ops)
             kwargs = {}
-            if op == "chaos":
+            if op == "kill" and rng.random() < 0.5:
+                kwargs = {"failpoint": rng.choice(kill_points)}
+            elif op == "chaos":
                 fpname, action = rng.choice(chaos_choices)
                 kwargs = {"failpoint": fpname, "action": action,
                           "delay_ms": rng.choice((10, 25, 50))}
@@ -150,6 +162,8 @@ def to_toml(m: Manifest) -> str:
         out += ["", "[[perturbations]]", f"node = {p.node}",
                 f'op = "{p.op}"', f"at_height = {p.at_height}",
                 f"duration = {p.duration}"]
+        if p.op == "kill" and p.failpoint:
+            out += [f'failpoint = "{p.failpoint}"']
         if p.op in ("chaos", "overload"):
             out += [f'failpoint = "{p.failpoint}"',
                     f'action = "{p.action}"',

@@ -14,7 +14,9 @@ TOML schema:
                                  #   disconnect_hard | restart | chaos
     at_height = 3                # trigger when the net reaches this
     duration = 3.0               # pause/disconnect/sever/chaos len (s)
-    failpoint = "wal.fsync"      # chaos only: named failpoint
+    failpoint = "wal.fsync"      # chaos: named failpoint to degrade;
+                                 # kill: crash AT this named commit-
+                                 # pipeline point instead of SIGKILL
     action = "delay"             # chaos only: error | delay | corrupt
     delay_ms = 25                # chaos only: delay action stall
 
@@ -62,6 +64,16 @@ class Perturbation:
             raise ValueError(f"perturbation node {self.node} out of range")
         if self.at_height < 1:
             raise ValueError("perturbation at_height must be >= 1")
+        if self.op == "kill" and self.failpoint:
+            # kill-at-named-point: the runner arms `crash` on this
+            # failpoint via the debug endpoint instead of SIGKILLing,
+            # so the node dies at a PRECISE commit-pipeline boundary
+            # and the restart proves handshake recovery from it.
+            from ..libs.failpoints import BY_NAME
+
+            if self.failpoint not in BY_NAME:
+                raise ValueError(
+                    f"unknown kill failpoint {self.failpoint!r}")
         if self.op == "disconnect_hard" and not 0 < self.duration <= 60:
             # same bound the unsafe_net_sever RPC enforces — reject at
             # manifest load, not mid-run

@@ -267,6 +267,10 @@ class Runner:
         # one report dict per applied `overload` perturbation —
         # heights/levels/shed deltas for the liveness assertions
         self.overload_reports: list[dict] = []
+        # one report dict per kill perturbation with a `failpoint` —
+        # did the armed crash fire, and did handshake recovery bring
+        # the node back past its kill height
+        self.kill_reports: list[dict] = []
 
     # -- stages --
 
@@ -308,6 +312,7 @@ class Runner:
                 cfg.rpc.unsafe = True  # exposes unsafe_net_sever
             pprof_port = 0
             if any(p.op in ("chaos", "overload")
+                   or (p.op == "kill" and p.failpoint)
                    for p in self.m.perturbations):
                 # chaos/overload perturbations drive the node's debug
                 # endpoint (POST /debug/failpoint, GET /status,
@@ -605,6 +610,9 @@ class Runner:
         self.log(f"perturb: {p.op} node{p.node} at net height "
                  f"{await self.net_height()}")
         if p.op == "kill":
+            if p.failpoint:
+                await self._apply_kill_at_failpoint(p, node)
+                return
             await asyncio.to_thread(node.kill9)
             await asyncio.sleep(1.0)
             node.start()  # must WAL-recover
@@ -644,6 +652,68 @@ class Runner:
                                     "action": "off"})
         else:  # pragma: no cover - manifest validated
             raise ValueError(p.op)
+
+    async def _apply_kill_at_failpoint(self, p: Perturbation,
+                                       node: NodeProc) -> None:
+        """Crash the node AT a named commit-pipeline point (arm
+        `crash` via the debug endpoint) instead of an arbitrary
+        SIGKILL, restart it, and record whether handshake recovery
+        brought it back past its kill height — the e2e face of
+        tools/crash_sweep.py. Falls back to SIGKILL if the armed point
+        does not fire within the window (the perturbation must not
+        wedge the run: e.g. statesync.chunk never fires on a synced
+        node)."""
+        h0 = await self.net_height()
+        res = await self._debug_post(node, "/debug/failpoint",
+                                     {"name": p.failpoint,
+                                      "action": "crash"})
+        assert "error" not in res, f"kill-failpoint arm failed: {res}"
+        crashed = False
+        for _ in range(int(max(p.duration, 10.0) * 4)):
+            if not node.alive():
+                crashed = True
+                break
+            await asyncio.sleep(0.25)
+        if not crashed:
+            self.log(f"perturb: kill failpoint {p.failpoint} never "
+                     f"fired on node{p.node}; falling back to SIGKILL")
+            await asyncio.to_thread(node.kill9)
+        elif node.proc is not None:
+            node.proc.wait()  # reap
+        await asyncio.sleep(1.0)
+        node.start()  # clean boot: handshake must heal the skew
+
+        # recovery assertion: the node's OWN height must pass its
+        # kill-time net height (bounded; the final wait_all_height
+        # still gates the whole run)
+        recovered_h = 0
+        recovered = False
+        async def sample():
+            nonlocal recovered_h
+            try:
+                recovered_h = max(recovered_h,
+                                  await self.height_of(node))
+            except Exception:
+                pass
+            return recovered_h
+
+        try:
+            await wait_progress(sample, lambda h: h > h0,
+                                timeout=60, stall_timeout=45,
+                                what=f"node{p.node} recovery past "
+                                     f"height {h0}")
+            recovered = True
+        except TimeoutError:
+            pass
+        report = {"node": p.node, "failpoint": p.failpoint,
+                  "crashed_at_point": crashed, "height_at_kill": h0,
+                  "recovered": recovered,
+                  "recovered_height": recovered_h}
+        self.kill_reports.append(report)
+        self.log(f"perturb: kill-at-failpoint report {report}")
+        assert recovered, (
+            f"node{p.node} failed to recover past height {h0} after "
+            f"crash at {p.failpoint}")
 
     async def _apply_overload(self, p: Perturbation,
                               node: NodeProc) -> None:
@@ -831,6 +901,8 @@ class Runner:
             report = await self.check()
             report["txs_sent"] = self._txs_sent
             report["valset_changes"] = self._valset_changes
+            if self.kill_reports:
+                report["kill_recoveries"] = self.kill_reports
             return report
         finally:
             self.stop_load()

@@ -112,11 +112,17 @@ class SqliteDB(DB):
     fsync-per-write durability contract FileDB had."""
 
     _CHUNK = 512  # iteration page size
+    SYNCHRONOUS = ("OFF", "NORMAL", "FULL")
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, synchronous: str = "FULL"):
         import sqlite3
 
         self.path = path
+        synchronous = synchronous.upper()
+        if synchronous not in self.SYNCHRONOUS:
+            raise ValueError(
+                f"db synchronous must be one of {self.SYNCHRONOUS}, "
+                f"not {synchronous!r}")
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         # autocommit mode; batches use explicit BEGIN IMMEDIATE.
         # check_same_thread off: the node is asyncio-single-threaded
@@ -125,7 +131,11 @@ class SqliteDB(DB):
         self._c = sqlite3.connect(path, isolation_level=None,
                                   check_same_thread=False)
         self._c.execute("PRAGMA journal_mode=WAL")
-        self._c.execute("PRAGMA synchronous=FULL")
+        # FULL (default) fsyncs the sqlite WAL on every commit — the
+        # per-height durability the commit pipeline assumes. NORMAL/OFF
+        # are opt-in (config base.db_synchronous) for replayable
+        # non-validator workloads; a crash can then lose the tail.
+        self._c.execute(f"PRAGMA synchronous={synchronous}")
         self._c.execute(
             "CREATE TABLE IF NOT EXISTS kv ("
             "k BLOB PRIMARY KEY, v BLOB NOT NULL) WITHOUT ROWID")
@@ -274,6 +284,12 @@ class FileDB(MemDB):
                 super().delete(key)
 
     def _append(self, payload: bytes) -> None:
+        """Write + fsync ONE crc-framed record. Called BEFORE the ops
+        are applied to the in-memory mirror: an append that raises
+        (injected db.set error, disk full) must leave memory and disk
+        agreeing — the old ordering mutated memory first, and a failed
+        append then left the process serving state the log never saw
+        (divergence that silently "healed" wrong on restart)."""
         from . import failpoints
 
         failpoints.hit("db.set")
@@ -282,6 +298,12 @@ class FileDB(MemDB):
         self._f.flush()
         os.fsync(self._f.fileno())
         self._log_bytes += len(rec)
+
+    def _maybe_compact(self) -> None:
+        # separate from _append: compaction rewrites the log from the
+        # in-memory mirror, so it must only ever run AFTER the ops of
+        # the record just appended have been applied to memory —
+        # compacting in between would drop them from the rewritten log.
         if (
             self._log_bytes > 1 << 20
             and self._log_bytes > self.COMPACT_RATIO * max(self._live_bytes, 1)
@@ -299,35 +321,45 @@ class FileDB(MemDB):
         return b"\x01" + struct.pack("<I", len(key)) + key
 
     def set(self, key: bytes, value: bytes) -> None:
+        self._append(self._enc_set(key, value))
         old = self._m.get(key)
         super().set(key, value)
         self._live_bytes += len(value) - (len(old) if old is not None else -len(key))
-        self._append(self._enc_set(key, value))
+        self._maybe_compact()
 
     def delete(self, key: bytes) -> None:
+        self._append(self._enc_del(key))
         old = self._m.get(key)
         if old is not None:
             self._live_bytes -= len(key) + len(old)
         super().delete(key)
-        self._append(self._enc_del(key))
+        self._maybe_compact()
 
     def write_batch(self, ops) -> None:
+        """ONE crc-framed record for the whole batch: a crash replays
+        to all of the batch or none of it (the record's crc fails as a
+        unit — _replay can never accept a half-applied batch). The
+        encode → append → apply order means a failed append leaves the
+        in-memory mirror untouched too."""
+        ops = list(ops)
         payload = bytearray()
+        for k, v in ops:
+            payload += self._enc_del(k) if v is None else self._enc_set(k, v)
+        if not payload:
+            return
+        self._append(bytes(payload))
         for k, v in ops:
             old = self._m.get(k)
             if v is None:
                 if old is not None:
                     self._live_bytes -= len(k) + len(old)
                 MemDB.delete(self, k)
-                payload += self._enc_del(k)
             else:
                 self._live_bytes += len(v) - (
                     len(old) if old is not None else -len(k)
                 )
                 MemDB.set(self, k, v)
-                payload += self._enc_set(k, v)
-        if payload:
-            self._append(bytes(payload))
+        self._maybe_compact()
 
     def compact(self) -> None:
         tmp = self.path + ".compact"

@@ -210,6 +210,32 @@ class HealthMonitor:
                                  f"{osnap['worst_fill']:.2f}")
         checks["overload"] = oc
 
+        # -- recovery: the last startup's reconciliation report
+        # (consensus/replay.py RecoveryReport). A repaired boot is a
+        # HEALTHY boot — status stays ok — but the repairs, the skew
+        # heights and any quarantined corruption evidence stay
+        # visible for the life of the process, so "did that crash
+        # recover cleanly?" is one GET away, not a log dig. --
+        rep = getattr(node, "recovery_report", None) \
+            if node is not None else None
+        if rep is not None:
+            rc: dict = {
+                "status": "ok",
+                "repairs": [r["kind"] for r in rep.get("repairs", [])],
+                "blocks_replayed": rep.get("blocks_replayed", 0),
+                "heights": {
+                    "app": rep.get("app_height", 0),
+                    "state": rep.get("state_height", 0),
+                    "store": rep.get("store_height", 0),
+                },
+            }
+            if rep.get("wal_tail_repaired_bytes"):
+                rc["wal_tail_repaired_bytes"] = \
+                    rep["wal_tail_repaired_bytes"]
+            if rep.get("quarantined_files"):
+                rc["quarantined_files"] = rep["quarantined_files"]
+            checks["recovery"] = rc
+
         # -- chaos: armed failpoints make a node degraded BY DESIGN —
         # the flag keeps an injection run from masquerading as healthy
         # (check only present while something is armed) --

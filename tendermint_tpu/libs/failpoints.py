@@ -144,10 +144,39 @@ CATALOG: tuple[FailpointDef, ...] = (
         "a snapshot chunk accepted from a peer (corrupt = bad chunk "
         "bytes; restore must fail the snapshot, not apply them)",
         payload=True),
+    FailpointDef(
+        "store.save_block",
+        "a block about to be persisted to the block store (one atomic "
+        "batch: meta + parts + commits + store state)"),
+    FailpointDef(
+        "privval.save",
+        "LastSignState about to be persisted (tmp+rename+fsync) — a "
+        "crash here must never let an unpersisted signature escape"),
 )
 
 BY_NAME: dict[str, FailpointDef] = {d.name: d for d in CATALOG}
 _LEGACY_SITES = frozenset(d.name for d in CATALOG if d.legacy_index)
+
+# The per-height COMMIT PIPELINE crash points, in persistence order:
+# every one of these sits between two durability steps of committing a
+# height, so a crash there leaves a legal cross-store skew the startup
+# reconciler (consensus/replay.py) must heal. tools/crash_sweep.py
+# arms each with `crash` against a real subprocess node and
+# tools/check_recovery.py lints that this tuple, the sweep's coverage
+# and the docs/CHAOS.md runbook table stay in sync.
+COMMIT_PIPELINE: tuple[str, ...] = (
+    "wal.fsync",
+    "db.set",
+    "store.save_block",
+    "consensus.commit.block_saved",
+    "consensus.commit.wal_delimited",
+    "state.apply.block_executed",
+    "state.apply.responses_saved",
+    "state.apply.app_committed",
+    "state.apply.state_saved",
+    "privval.save",
+)
+assert all(n in BY_NAME for n in COMMIT_PIPELINE)
 
 
 class _Armed:

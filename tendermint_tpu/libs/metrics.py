@@ -580,6 +580,29 @@ class FailpointMetrics:
 
 
 @dataclass
+class RecoveryMetrics:
+    """Startup reconciliation (consensus/replay.py): every legal
+    cross-store skew a crash can leave is enumerated and healed on
+    boot, and each heal is counted here — a fleet whose repair
+    counters climb without chaos injections has a disk/crash problem
+    worth paging on."""
+    repairs: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "repairs_total",
+        "Cross-store skews healed by the startup reconciler, by "
+        "repair kind.", "recovery"))
+    blocks_replayed: Counter = field(
+        default_factory=lambda: DEFAULT.counter(
+            "blocks_replayed_total",
+            "Blocks replayed into the app or re-applied to state "
+            "during startup reconciliation.", "recovery"))
+    quarantined_files: Gauge = field(
+        default_factory=lambda: DEFAULT.gauge(
+            "quarantined_files",
+            "Corruption-evidence files (*.corrupt.NNN) present in the "
+            "data/WAL dirs at the last startup scan.", "recovery"))
+
+
+@dataclass
 class RPCMetrics:
     """JSON-RPC server overload surface (this framework's addition):
     the 429-style limiter and the bounded websocket event queue."""
@@ -701,6 +724,10 @@ def overload_metrics() -> OverloadMetrics:
     return _singleton("overload", OverloadMetrics)
 
 
+def recovery_metrics() -> RecoveryMetrics:
+    return _singleton("recovery", RecoveryMetrics)
+
+
 # ------------------------------------------------- MetricsProvider wiring
 
 @dataclass
@@ -724,6 +751,7 @@ class NodeMetrics:
     failpoint: FailpointMetrics
     rpc: RPCMetrics
     overload: OverloadMetrics
+    recovery: RecoveryMetrics
 
 
 def node_metrics() -> NodeMetrics:
@@ -738,6 +766,7 @@ def node_metrics() -> NodeMetrics:
         abci=abci_metrics(), tpu=tpu_metrics(),
         tracing=tracing_metrics(), failpoint=failpoint_metrics(),
         rpc=rpc_metrics(), overload=overload_metrics(),
+        recovery=recovery_metrics(),
     )
 
 

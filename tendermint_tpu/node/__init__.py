@@ -18,7 +18,7 @@ from ..abci.kvstore import KVStoreApp, PersistentKVStoreApp
 from ..blockchain.reactor import BlockchainReactor
 from ..config import Config
 from ..consensus.reactor import ConsensusReactor
-from ..consensus.replay import handshake_and_load_state
+from ..consensus.replay import reconcile_and_handshake
 from ..consensus.state import ConsensusState
 from ..consensus.wal import WAL
 from ..evidence import Pool as EvidencePool
@@ -87,7 +87,7 @@ def _db(config: Config, name: str, in_memory: bool) -> DB:
 
         sq_path = os.path.join(d, f"{name}.sqlite")
         fdb_path = os.path.join(d, f"{name}.db")
-        db = SqliteDB(sq_path)
+        db = SqliteDB(sq_path, synchronous=config.base.db_synchronous)
         sq_empty = next(iter(db.iterate()), None) is None
         if os.path.exists(fdb_path) and sq_empty:
             # A pre-sqlite data dir: silently opening an empty store
@@ -182,9 +182,20 @@ class Node(Service):
         self.proxy_app = AppConns(self.client_creator)
         await self.proxy_app.start()
 
-        self.state = await handshake_and_load_state(
+        # Startup reconciliation: WAL tail repair + quarantine
+        # inventory + handshake-with-skew-healing. The report sticks
+        # around for /status (HealthMonitor `recovery` check) and the
+        # `recovery` metrics namespace counted each repair already.
+        wal_path = None if self.in_memory else \
+            cfg.base.resolve(cfg.consensus.wal_file)
+        scan_dirs = [] if self.in_memory else [
+            cfg.base.resolve(cfg.base.db_dir),
+            os.path.dirname(wal_path) or ".",
+        ]
+        self.state, recovery_report = await reconcile_and_handshake(
             None, self.state_store, self.block_store, self.genesis_doc,
-            self.proxy_app)
+            self.proxy_app, wal_path=wal_path, scan_dirs=scan_dirs)
+        self.recovery_report = recovery_report.to_dict()
 
         self.evpool = EvidencePool(_db(cfg, "evidence", self.in_memory),
                                    self.state_store, self.block_store)

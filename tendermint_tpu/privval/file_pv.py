@@ -149,13 +149,23 @@ class FilePV:
                 d.get("sign_bytes") or d.get("signbytes") or ""),
         )
 
-    def _save_state(self) -> None:
+    def _save_state(self, lss: LastSignState | None = None) -> None:
         """Persist + fsync BEFORE the signature escapes — this ordering
-        IS the double-sign protection (reference file.go saveSigned)."""
+        IS the double-sign protection (reference file.go saveSigned).
+        tmp + fsync + rename + directory fsync: the rename itself must
+        be durable, or a crash right after can resurrect the OLD state
+        file while the new signature is already on the wire."""
         if not self.state_path:
             return
-        lss = self.last_sign_state
-        os.makedirs(os.path.dirname(self.state_path) or ".", exist_ok=True)
+        lss = lss if lss is not None else self.last_sign_state
+        from ..libs import failpoints
+
+        # chaos: a crash/error here models dying between signing and
+        # persistence — the signature must then never escape (the
+        # caller installs + releases only after this returns).
+        failpoints.hit("privval.save")
+        d = os.path.dirname(self.state_path) or "."
+        os.makedirs(d, exist_ok=True)
         tmp = self.state_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({
@@ -166,6 +176,14 @@ class FilePV:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.state_path)
+        try:
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass  # some filesystems refuse directory fsync; best effort
 
     # -- PrivValidator ---------------------------------------------------
 
@@ -212,10 +230,16 @@ class FilePV:
                 f"conflicting data at {height}/{round_}/{step}: "
                 "refusing to double-sign")
         sig = self.priv_key.sign(sign_bytes)
-        self.last_sign_state = LastSignState(
+        new_lss = LastSignState(
             height=height, round=round_, step=step,
             signature=sig, sign_bytes=sign_bytes)
-        self._save_state()
+        # Durable BEFORE installed: if the persist raises (disk error,
+        # injected privval.save fault) the in-memory state must stay at
+        # the old HRS too — installing first would let a later retry
+        # re-release a signature the state file never recorded, and a
+        # crash after that re-release could double-sign at this HRS.
+        self._save_state(new_lss)
+        self.last_sign_state = new_lss
         return sig, None
 
 

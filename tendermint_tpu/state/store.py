@@ -136,19 +136,23 @@ class Store:
 
     def bootstrap(self, state: State) -> None:
         """Seed the store from an out-of-band trusted state (statesync;
-        reference state/store.go:188)."""
+        reference state/store.go:188). ONE batch: these rows used to go
+        out as four separate write_batch calls plus a set, so a crash
+        mid-bootstrap could leave a height with a validator set but no
+        state row (or vice versa) — a skew no startup reconciler can
+        tell apart from corruption. All-or-nothing now."""
         height = state.last_block_height + 1
         if height == 1:
             height = state.initial_height
+        ops: list[tuple[bytes, bytes | None]] = []
         if height > 1 and len(state.last_validators):
-            self.db.write_batch(self._valset_ops(height - 1, state.last_validators))
-        self.db.write_batch(self._valset_ops(height, state.validators))
-        self.db.write_batch(self._valset_ops(height + 1, state.next_validators))
-        self.db.write_batch(
-            self._params_ops(height, state.consensus_params,
-                             state.last_height_consensus_params_changed)
-        )
-        self.db.set(_STATE_KEY, self._state_bytes(state))
+            ops += self._valset_ops(height - 1, state.last_validators)
+        ops += self._valset_ops(height, state.validators)
+        ops += self._valset_ops(height + 1, state.next_validators)
+        ops += self._params_ops(height, state.consensus_params,
+                                state.last_height_consensus_params_changed)
+        ops.append((_STATE_KEY, self._state_bytes(state)))
+        self.db.write_batch(ops)
 
     # -- validator sets (sparse) --
 

@@ -99,10 +99,20 @@ class BlockStore:
             ops.append(
                 (b"C:" + _h(height - 1), block.last_commit.to_proto().finish())
             )
-        self.base = self.base or height
-        self.height = height
-        ops.append((_STORE_KEY, self._state_bytes()))
+        new_base = self.base or height
+        ops.append((_STORE_KEY, self._state_bytes(new_base, height)))
+        # chaos: the commit pipeline's first durability step — a crash
+        # here must leave the previous height fully intact (the batch
+        # below is atomic at the DB level) and the startup reconciler
+        # simply re-enters the height. The in-memory (base, height)
+        # update comes AFTER the batch lands: a failed write must not
+        # leave this store claiming a height the DB never saw.
+        from ..libs import failpoints
+
+        failpoints.hit("store.save_block")
         self.db.write_batch(ops)
+        self.base = new_base
+        self.height = height
 
     def save_seen_commit(self, height: int, commit: Commit) -> None:
         self.db.set(b"SC:" + _h(height), commit.to_proto().finish())
@@ -126,10 +136,11 @@ class BlockStore:
             for i in range(meta.block_id.part_set_header.total):
                 ops.append((b"P:" + _h(height) + struct.pack(">I", i), None))
             pruned += 1
-        self.base = retain_height
-        ops.append((_STORE_KEY, self._state_bytes()))
+        ops.append((_STORE_KEY, self._state_bytes(retain_height,
+                                                  self.height)))
         self.db.write_batch(ops)
+        self.base = retain_height
         return pruned
 
-    def _state_bytes(self) -> bytes:
-        return json.dumps({"base": self.base, "height": self.height}).encode()
+    def _state_bytes(self, base: int, height: int) -> bytes:
+        return json.dumps({"base": base, "height": height}).encode()

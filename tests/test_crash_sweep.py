@@ -1,0 +1,64 @@
+"""Subprocess crash-point recovery sweep (slow tier).
+
+tools/crash_sweep.py arms a `crash` on each commit-pipeline failpoint
+via TM_TPU_FAILPOINTS, kills a REAL solo-validator node mid-height,
+restarts it clean, and asserts the recovery invariants (liveness past
+the crash, clean-run app-hash oracle, monotone heights, mutually
+consistent stores, privval sign-state never regressing). The
+in-process fast path — torn batches + reconciler skews — runs in the
+default tier from tests/test_recovery.py.
+"""
+
+import os
+import sys
+
+import pytest
+
+TOOLS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+import crash_sweep  # noqa: E402
+
+from tendermint_tpu.libs.failpoints import COMMIT_PIPELINE  # noqa: E402
+
+# Pins the sweep's coverage to the catalog with LITERAL names (like
+# test_failpoint_sweep.py's LEGACY_SITE_ORDER): a point added to
+# COMMIT_PIPELINE without sweep coverage fails here AND in
+# tools/check_recovery.py.
+PIPELINE_ORDER = [
+    "wal.fsync",
+    "db.set",
+    "store.save_block",
+    "consensus.commit.block_saved",
+    "consensus.commit.wal_delimited",
+    "state.apply.block_executed",
+    "state.apply.responses_saved",
+    "state.apply.app_committed",
+    "state.apply.state_saved",
+    "privval.save",
+]
+
+
+def test_pipeline_order_matches_catalog():
+    assert PIPELINE_ORDER == list(COMMIT_PIPELINE)
+    assert set(crash_sweep.SWEEP_SPECS) == set(COMMIT_PIPELINE)
+
+
+@pytest.fixture(scope="module")
+def oracle(tmp_path_factory):
+    """One clean solo run per module: height -> app hash hex."""
+    pytest.importorskip("cryptography")
+    out = str(tmp_path_factory.mktemp("oracle"))
+    return crash_sweep.oracle_run(out, 0, upto=6)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("point", PIPELINE_ORDER)
+def test_crash_point_recovers(tmp_path, point, oracle):
+    report = crash_sweep.run_case(
+        str(tmp_path / "net"), point,
+        10 * (1 + PIPELINE_ORDER.index(point)), oracle=oracle)
+    assert report["ok"]
+    assert report["advanced_to"] >= report["resumed_at"] + 2

@@ -88,6 +88,51 @@ def test_restart_resigns_identically(tmp_path):
         pv2.sign_vote(CHAIN, _vote(5, 1, bid=_bid(8)))
 
 
+def test_crash_between_sign_and_persist_survives(tmp_path):
+    """Satellite: a crash between signing and LastSignState
+    persistence (the `privval.save` failpoint) must never let the
+    signature escape OR advance the in-memory state past the disk
+    state — after restart, double-sign protection still holds at the
+    last PERSISTED height/round/step, and the crashed (never-released)
+    vote can be re-signed safely."""
+    from tendermint_tpu.libs import failpoints as fp
+
+    key, st = str(tmp_path / "key.json"), str(tmp_path / "state.json")
+    pv = FilePV.generate(key, st)
+    v1 = _vote(1, 0)
+    pv.sign_vote(CHAIN, v1)  # durably at (1, 0, prevote)
+
+    fp.reset()
+    fp.arm("privval.save", "error")
+    try:
+        v2 = _vote(1, 0, type_=VoteType.PRECOMMIT)
+        with pytest.raises(fp.FailpointError):
+            pv.sign_vote(CHAIN, v2)
+        # the signature did NOT escape...
+        assert not v2.signature
+        # ...and memory did not run ahead of disk: a same-process
+        # retry must re-sign through the persist, never re-release an
+        # unpersisted signature from memory
+        lss = pv.last_sign_state
+        assert (lss.height, lss.round, lss.step) == (1, 0, 2)
+    finally:
+        fp.reset()
+
+    # crash-restart: reload from the state file
+    pv2 = FilePV.load(key, st)
+    lss = pv2.last_sign_state
+    assert (lss.height, lss.round, lss.step) == (1, 0, 2)
+    # conflicting data at the persisted HRS is still refused
+    with pytest.raises(RemoteSignError, match="double-sign"):
+        pv2.sign_vote(CHAIN, _vote(1, 0, bid=_bid(9)))
+    # the crashed precommit never escaped, so signing it fresh is safe
+    v3 = _vote(1, 0, type_=VoteType.PRECOMMIT)
+    pv2.sign_vote(CHAIN, v3)
+    assert v3.signature
+    assert pv2.get_pub_key().verify_signature(v3.sign_bytes(CHAIN),
+                                              v3.signature)
+
+
 def test_proposal_signing(tmp_path):
     pv = FilePV.generate(str(tmp_path / "k.json"), str(tmp_path / "s.json"))
     p = Proposal(height=1, round=0, pol_round=-1, block_id=_bid(1),

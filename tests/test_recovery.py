@@ -1,0 +1,414 @@
+"""Crash-recovery correctness — the sweep's tier-1 fast path.
+
+Three layers (the subprocess crash matrix lives in
+tests/test_crash_sweep.py, slow tier):
+
+  * storage atomicity: FileDB batches are ONE crc-framed record (a
+    torn batch replays to none of it, never half), a failed append
+    leaves memory and disk agreeing, SqliteDB durability is
+    configurable but validated;
+  * startup reconciliation: every legal cross-store skew a
+    commit-pipeline crash can leave (libs/failpoints.py
+    COMMIT_PIPELINE) is constructed against REAL stores + a real
+    kvstore app by stopping the actual commit pipeline at the named
+    boundary, then healed by reconcile_and_handshake — asserting the
+    post-recovery state, the app-hash oracle, and the named repairs in
+    the RecoveryReport;
+  * surfaces: the `recovery` metrics namespace, the /status recovery
+    check, and the tools/check_recovery.py coverage lint.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from tendermint_tpu.abci.client import ClientCreator
+from tendermint_tpu.abci.kvstore import PersistentKVStoreApp
+from tendermint_tpu.consensus.replay import (
+    REPAIR_KINDS, reconcile_and_handshake,
+)
+from tendermint_tpu.libs import failpoints as fp
+from tendermint_tpu.libs.db import FileDB, MemDB, SqliteDB, _HDR
+from tendermint_tpu.proxy import AppConns
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.store import Store
+from tendermint_tpu.store import BlockStore
+
+from helpers import commit_for, make_genesis, next_block
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.reset()
+    yield
+    fp.reset()
+
+
+# ---------------------------------------------------------------- storage
+
+
+def test_filedb_batch_is_one_record(tmp_path):
+    """Satellite pin: write_batch appends ONE crc-framed record for
+    the whole batch — counted directly off the on-disk framing."""
+    path = str(tmp_path / "kv.db")
+    db = FileDB(path)
+    db.write_batch([(b"a", b"1"), (b"b", b"2"), (b"c", None),
+                    (b"d", b"4")])
+    db.close()
+    with open(path, "rb") as f:
+        data = f.read()
+    records = 0
+    pos = 0
+    while pos + _HDR.size <= len(data):
+        _, ln = _HDR.unpack_from(data, pos)
+        pos += _HDR.size + ln
+        records += 1
+    assert records == 1, f"batch wrote {records} records"
+
+
+def test_filedb_torn_batch_replays_all_or_nothing(tmp_path):
+    """A crash tearing the batch record mid-write must replay to NONE
+    of the batch — _replay can never accept a half-applied batch
+    (the crc covers the whole record)."""
+    path = str(tmp_path / "kv.db")
+    db = FileDB(path)
+    db.set(b"before", b"ok")
+    size_before = os.path.getsize(path)
+    db.write_batch([(b"x", b"1"), (b"y", b"2"), (b"z", b"3")])
+    db.close()
+
+    # tear the batch record: drop its last byte
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 1)
+    db2 = FileDB(path)
+    assert db2.get(b"before") == b"ok"
+    for k in (b"x", b"y", b"z"):
+        assert db2.get(k) is None, f"half-applied batch leaked {k}"
+    # the torn tail was quarantined, not silently destroyed
+    assert os.path.exists(path + ".corrupt.000")
+    assert os.path.getsize(path) == size_before
+    db2.close()
+
+
+def test_filedb_failed_append_keeps_memory_and_disk_agreeing(tmp_path):
+    """An append that raises (injected db.set error = disk full shape)
+    must leave the in-memory mirror untouched: the old order mutated
+    memory first and served phantom state until restart."""
+    path = str(tmp_path / "kv.db")
+    db = FileDB(path)
+    db.set(b"a", b"1")
+    fp.arm("db.set", "error")
+    with pytest.raises(fp.FailpointError):
+        db.set(b"b", b"2")
+    with pytest.raises(fp.FailpointError):
+        db.write_batch([(b"c", b"3"), (b"a", None)])
+    with pytest.raises(fp.FailpointError):
+        db.delete(b"a")
+    fp.reset()
+    # memory agrees with disk: nothing from the failed ops
+    assert db.get(b"b") is None and db.get(b"c") is None
+    assert db.get(b"a") == b"1"
+    db.close()
+    db2 = FileDB(path)
+    assert db2.get(b"a") == b"1" and db2.get(b"b") is None
+    db2.close()
+
+
+def test_sqlitedb_synchronous_configurable(tmp_path):
+    for mode in ("FULL", "normal", "OFF"):
+        db = SqliteDB(str(tmp_path / f"kv-{mode}.sqlite"),
+                      synchronous=mode)
+        db.set(b"k", b"v")
+        assert db.get(b"k") == b"v"
+        db.close()
+    with pytest.raises(ValueError, match="synchronous"):
+        SqliteDB(str(tmp_path / "bad.sqlite"), synchronous="EXTRA")
+    from tendermint_tpu.config import Config
+
+    cfg = Config()
+    cfg.base.db_synchronous = "sometimes"
+    with pytest.raises(ValueError, match="db_synchronous"):
+        cfg.validate_basic()
+
+
+# ------------------------------------------- reconciler skew fast path
+
+# Crash boundary -> (expected repairs, expected recovered height rel.
+# to the crash height N). Constructed by stopping the REAL commit
+# pipeline at the named point (state.apply.* via the armed failpoint
+# inside BlockExecutor.apply_block; the store-level points by stopping
+# between the explicit steps).
+SKEW_CASES = {
+    # nothing of height N persisted: stores consistent at N-1, no
+    # repair, consensus simply re-enters the height
+    "store.save_block": ([], -1),
+    # block N saved, nothing else: full re-apply through the executor
+    "consensus.commit.block_saved": (["state_reapply"], 0),
+    "state.apply.block_executed": (["state_reapply"], 0),
+    "state.apply.responses_saved": (["state_reapply"], 0),
+    # app committed N, state didn't: rebuilt from saved responses
+    "state.apply.app_committed": (["state_from_responses"], 0),
+    # everything durable, only events unfired: nothing to repair
+    "state.apply.state_saved": ([], 0),
+}
+
+
+def _open(tmp_path, tag=""):
+    return (FileDB(str(tmp_path / f"state{tag}.db")),
+            FileDB(str(tmp_path / f"blocks{tag}.db")),
+            FileDB(str(tmp_path / f"app{tag}.db")))
+
+
+async def _grow_chain(gdoc, pvs, state_db, block_db, app_db, heights,
+                      crash_at=None):
+    """Drive the REAL commit pipeline (save_block -> apply_block) for
+    `heights` heights; on the LAST height stop at `crash_at` (None =
+    run it to completion). Returns the app hash by height observed on
+    the clean path."""
+    app = PersistentKVStoreApp(app_db)
+    conns = AppConns(ClientCreator(app=app))
+    await conns.start()
+    hashes = {}
+    try:
+        state_store = Store(state_db)
+        block_store = BlockStore(block_db)
+        state, _ = await reconcile_and_handshake(
+            None, state_store, block_store, gdoc, conns)
+        executor = BlockExecutor(state_store, conns.consensus)
+        last_commit = None
+        for i in range(heights):
+            h = state.last_block_height + 1
+            block, bid = next_block(state, pvs, last_commit,
+                                    [b"h%d=x" % h])
+            seen = commit_for(state, pvs, block, bid)
+            last = i == heights - 1
+            if last and crash_at == "store.save_block":
+                fp.arm("store.save_block", "error")
+                with pytest.raises(fp.FailpointError):
+                    block_store.save_block(block, block.make_part_set(),
+                                           seen)
+                fp.reset()
+                return hashes
+            block_store.save_block(block, block.make_part_set(), seen)
+            if last and crash_at == "consensus.commit.block_saved":
+                return hashes
+            if last and crash_at is not None:
+                fp.arm(crash_at, "error")
+                with pytest.raises(fp.FailpointError):
+                    await executor.apply_block(state, bid, block)
+                fp.reset()
+                return hashes
+            state, _ = await executor.apply_block(state, bid, block)
+            hashes[h] = state.app_hash
+            last_commit = seen
+        return hashes
+    finally:
+        fp.reset()
+        await conns.stop()
+
+
+def _oracle_hashes(tmp_path, gdoc, pvs, heights):
+    state_db, block_db, app_db = (MemDB(), MemDB(), MemDB())
+    return asyncio.run(_grow_chain(gdoc, pvs, state_db, block_db,
+                                   app_db, heights))
+
+
+@pytest.mark.parametrize("point", sorted(SKEW_CASES))
+def test_reconciler_heals_commit_pipeline_skew(tmp_path, point):
+    """For every commit-pipeline boundary: crash there at height N,
+    restart from disk, and the reconciler must (a) heal to a
+    consistent state, (b) match the clean-run app-hash oracle, (c)
+    name exactly the expected repairs in its report, and (d) keep
+    committing — the healed chain extends by one more height whose app
+    hash also matches the oracle."""
+    expected_repairs, rel = SKEW_CASES[point]
+    gdoc, pvs = make_genesis(1)
+    crash_h = 3
+    oracle = _oracle_hashes(tmp_path, gdoc, pvs, crash_h + 1)
+
+    async def go():
+        state_db, block_db, app_db = _open(tmp_path)
+        await _grow_chain(gdoc, pvs, state_db, block_db, app_db,
+                          crash_h, crash_at=point)
+        state_db.close(), block_db.close(), app_db.close()
+
+        # crash-restart: everything reopened from disk
+        state_db2, block_db2, app_db2 = _open(tmp_path)
+        app = PersistentKVStoreApp(app_db2)
+        conns = AppConns(ClientCreator(app=app))
+        await conns.start()
+        try:
+            state_store = Store(state_db2)
+            block_store = BlockStore(block_db2)
+            state, report = await reconcile_and_handshake(
+                None, state_store, block_store, gdoc, conns)
+            want_h = crash_h + rel
+            assert state.last_block_height == want_h, \
+                f"recovered to {state.last_block_height}, want {want_h}"
+            assert [r["kind"] for r in report.repairs] == \
+                expected_repairs, report.repairs
+            # stores mutually consistent + app agrees
+            assert block_store.height in (want_h, want_h + 1)
+            assert state.app_hash == app.app_hash
+            if want_h in oracle:
+                assert state.app_hash == oracle[want_h], \
+                    "recovered app state diverged from clean-run oracle"
+
+            # and the healed chain KEEPS COMMITTING correctly
+            executor = BlockExecutor(state_store, conns.consensus)
+            last_commit = block_store.load_seen_commit(
+                state.last_block_height)
+            nxt = state.last_block_height + 1
+            block, bid = next_block(state, pvs, last_commit,
+                                    [b"h%d=x" % nxt])
+            seen = commit_for(state, pvs, block, bid)
+            if block_store.height < nxt:
+                block_store.save_block(block, block.make_part_set(),
+                                       seen)
+            state, _ = await executor.apply_block(state, bid, block)
+            if nxt in oracle:
+                assert state.app_hash == oracle[nxt], \
+                    "post-recovery commit diverged from oracle"
+        finally:
+            await conns.stop()
+            state_db2.close(), block_db2.close(), app_db2.close()
+
+    asyncio.run(go())
+
+
+def test_reconciler_repairs_feed_metrics_and_wal(tmp_path):
+    """A torn WAL tail is quarantined + reported (wal_torn_tail), the
+    quarantine inventory lands on the report and the gauge, and every
+    repair moved the `recovery` counters."""
+    from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
+    from tendermint_tpu.libs.metrics import recovery_metrics
+
+    gdoc, pvs = make_genesis(1)
+    wal_path = str(tmp_path / "wal" / "wal")
+    w = WAL(wal_path)
+    w.write_sync(EndHeightMessage(1))
+    w.write_sync(EndHeightMessage(2))
+    w.close()
+    with open(wal_path, "ab") as f:
+        f.write(b"\xde\xad\xbe\xef-torn-tail")
+
+    async def go():
+        state_db, block_db, app_db = _open(tmp_path)
+        await _grow_chain(gdoc, pvs, state_db, block_db, app_db, 2,
+                          crash_at="consensus.commit.block_saved")
+        state_db.close(), block_db.close(), app_db.close()
+
+        state_db2, block_db2, app_db2 = _open(tmp_path)
+        conns = AppConns(ClientCreator(
+            app=PersistentKVStoreApp(app_db2)))
+        await conns.start()
+        m = recovery_metrics()
+        before = m.repairs.value(kind="state_reapply")
+        before_wal = m.repairs.value(kind="wal_torn_tail")
+        try:
+            state, report = await reconcile_and_handshake(
+                None, Store(state_db2), BlockStore(block_db2), gdoc,
+                conns, wal_path=wal_path,
+                scan_dirs=[str(tmp_path / "wal")])
+            kinds = [r["kind"] for r in report.repairs]
+            assert kinds == ["wal_torn_tail", "state_reapply"], kinds
+            assert report.wal_tail_repaired_bytes > 0
+            assert report.wal_end_height == 2
+            assert any(".corrupt." in p
+                       for p in report.quarantined_files)
+            assert state.last_block_height == 2
+            assert m.repairs.value(kind="state_reapply") == before + 1
+            assert m.repairs.value(kind="wal_torn_tail") == \
+                before_wal + 1
+            assert m.quarantined_files.value() >= 1
+            # the WAL head decodes clean after the repair
+            assert [x.msg.height for x in WAL.decode_all(wal_path)] == \
+                [1, 2]
+        finally:
+            await conns.stop()
+            state_db2.close(), block_db2.close(), app_db2.close()
+
+    asyncio.run(go())
+
+
+def test_reconciler_wal_end_height_survives_rotation(tmp_path):
+    """Crash right after a WAL rotation leaves an empty head: the
+    newest EndHeightMessage sits in a rotated segment, and the report
+    must still find it (not show wal_end_height = null)."""
+    from tendermint_tpu.consensus.replay import (
+        RecoveryReport, _reconcile_wal,
+    )
+    from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
+
+    wal_path = str(tmp_path / "wal" / "wal")
+    w = WAL(wal_path)
+    w.write_sync(EndHeightMessage(1))
+    w.write_sync(EndHeightMessage(2))
+    w._rotate()  # head now empty; markers live in wal.000
+    w.close()
+
+    report = RecoveryReport()
+    _reconcile_wal(wal_path, report)
+    assert report.wal_end_height == 2
+    assert report.repairs == []  # clean head, nothing repaired
+
+
+# ----------------------------------------------------------- surfaces
+
+
+def test_status_surfaces_recovery_report():
+    from types import SimpleNamespace
+
+    from tendermint_tpu.libs.debugsrv import HealthMonitor
+
+    node = SimpleNamespace(
+        switch=None, mempool=None,
+        recovery_report={
+            "app_height": 4, "state_height": 5, "store_height": 5,
+            "wal_end_height": 5, "wal_tail_repaired_bytes": 17,
+            "quarantined_files": ["/x/wal.corrupt.000"],
+            "repairs": [{"kind": "wal_torn_tail", "detail": "d"},
+                        {"kind": "app_replay", "detail": "d"}],
+            "blocks_replayed": 1,
+        })
+    st = HealthMonitor(node).status()
+    rc = st["checks"]["recovery"]
+    assert rc["status"] == "ok"  # a repaired boot is a healthy boot
+    assert rc["repairs"] == ["wal_torn_tail", "app_replay"]
+    assert rc["blocks_replayed"] == 1
+    assert rc["heights"] == {"app": 4, "state": 5, "store": 5}
+    assert rc["wal_tail_repaired_bytes"] == 17
+    assert rc["quarantined_files"] == ["/x/wal.corrupt.000"]
+    # no node attached -> no recovery check (bare DebugServer)
+    assert "recovery" not in HealthMonitor(None).status()["checks"]
+
+
+def test_repair_kinds_closed_catalog():
+    """record() refuses unknown repair kinds — the report vocabulary
+    stays lint-able (docs table <-> catalog)."""
+    from tendermint_tpu.consensus.replay import RecoveryReport
+
+    rep = RecoveryReport()
+    with pytest.raises(AssertionError):
+        rep.record("made_up_kind", "nope")
+    for kind in REPAIR_KINDS:
+        rep.record(kind, "exercised")
+    assert len(rep.repairs) == len(REPAIR_KINDS)
+
+
+def test_check_recovery_lint_from_suite():
+    """Commit-pipeline catalog <-> crash-sweep coverage <-> docs
+    runbook stay in sync (tools/check_recovery.py), like
+    check_failpoints/check_metrics."""
+    import sys
+
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import check_recovery
+
+    problems = check_recovery.collect_problems()
+    assert not problems, "\n".join(problems)

@@ -117,6 +117,78 @@ def test_state_store_abci_responses():
     assert store.load_abci_responses(8) is None
 
 
+class _CountingDB(MemDB):
+    """Counts the durability operations a caller issues — the pin for
+    single-batch contracts."""
+
+    def __init__(self):
+        super().__init__()
+        self.batches = 0
+        self.sets = 0  # direct (non-batch) durability calls
+        self._in_batch = False
+
+    def write_batch(self, ops):
+        self.batches += 1
+        self._in_batch = True
+        try:
+            super().write_batch(list(ops))
+        finally:
+            self._in_batch = False
+
+    def set(self, key, value):
+        if not self._in_batch:  # MemDB batches dispatch through set()
+            self.sets += 1
+        super().set(key, value)
+
+
+def test_bootstrap_is_one_atomic_batch():
+    """Satellite pin (state/store.py): the statesync bootstrap used to
+    issue FOUR write_batch calls plus a set — a crash mid-bootstrap
+    could leave a height with a validator set but no state row. All
+    rows must go out in ONE batch now."""
+    _, _, state, _ = build_chain(3)  # height 3, last_validators set
+    db = _CountingDB()
+    Store(db).bootstrap(state)
+    assert db.batches == 1, \
+        f"bootstrap issued {db.batches} batches + {db.sets} sets"
+    assert db.sets == 0
+    # and the batch carried everything: state row + the three valsets
+    # around the bootstrap height + params
+    store = Store(db)
+    loaded = store.load()
+    assert loaded is not None and loaded.last_block_height == 3
+    for h in (3, 4, 5):
+        assert store.load_validators(h) is not None, f"valset {h} missing"
+    assert store.load_consensus_params(4) is not None
+
+
+def test_bootstrap_crash_leaves_no_partial_rows(tmp_path):
+    """The reason the batch matters: an injected failure during the
+    bootstrap write leaves NO rows behind (FileDB appends the whole
+    batch as one crc-framed record)."""
+    import pytest
+
+    from tendermint_tpu.libs import failpoints as fp
+    from tendermint_tpu.libs.db import FileDB
+
+    _, _, state, _ = build_chain(2)
+    path = str(tmp_path / "state.db")
+    db = FileDB(path)
+    fp.reset()
+    fp.arm("db.set", "error")
+    try:
+        with pytest.raises(fp.FailpointError):
+            Store(db).bootstrap(state)
+    finally:
+        fp.reset()
+        db.close()
+    db2 = FileDB(path)
+    store = Store(db2)
+    assert store.load() is None
+    assert store.load_validators(2) is None
+    db2.close()
+
+
 def test_state_store_prune():
     store = Store(MemDB())
     state, _ = make_genesis_state_and_pvs(2)

@@ -35,7 +35,7 @@ DOCS = os.path.join(REPO, "docs", "OBSERVABILITY.md")
 NAMESPACES = {
     "consensus", "crypto", "p2p", "mempool", "blockchain", "statesync",
     "evidence", "state", "abci", "tpu", "tracing", "failpoint", "rpc",
-    "overload",
+    "overload", "recovery",
 }
 
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
