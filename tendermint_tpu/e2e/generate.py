@@ -89,11 +89,18 @@ def generate(rng: random.Random) -> Manifest:
                 kwargs = {"failpoint": fpname, "action": action,
                           "delay_ms": rng.choice((10, 25, 50))}
             elif op == "overload":
-                # throttle one of the two host hot paths under flood
-                fpname = rng.choice(("device.verify", "abci.deliver"))
+                # throttle one of the host hot paths under flood —
+                # including the admission plane's batch verify, with a
+                # signed/garbage envelope mix so the shed path runs
+                fpname = rng.choice(("device.verify", "abci.deliver",
+                                     "mempool.admission.verify"))
                 kwargs = {"failpoint": fpname, "action": "delay",
                           "delay_ms": rng.choice((10, 25)),
                           "tx_rate": rng.choice((100.0, 200.0))}
+                if fpname == "mempool.admission.verify" \
+                        or rng.random() < 0.5:
+                    kwargs["tx_garbage"] = rng.choice((0.2, 0.5))
+                    kwargs["tx_signed"] = rng.choice((0.0, 0.1))
             m.perturbations.append(Perturbation(
                 node=i,
                 op=op,
@@ -170,6 +177,9 @@ def to_toml(m: Manifest) -> str:
                     f"delay_ms = {p.delay_ms}"]
         if p.op == "overload":
             out += [f"tx_rate = {p.tx_rate}"]
+            if p.tx_signed or p.tx_garbage:
+                out += [f"tx_signed = {p.tx_signed}",
+                        f"tx_garbage = {p.tx_garbage}"]
     for vu in m.validator_updates:
         out += ["", "[[validator_updates]]", f"node = {vu.node}",
                 f"at_height = {vu.at_height}", f"power = {vu.power}"]

@@ -56,6 +56,12 @@ class Perturbation:
     delay_ms: float = 25.0
     # overload op only: broadcast_tx_async flood rate (txs/s)
     tx_rate: float = 200.0
+    # overload op only: fraction of flood txs wrapped in VALID
+    # tx_envelope signatures / GARBAGE-signature envelopes, so the
+    # flood exercises the mempool admission plane's verify+shed path
+    # (the rest are raw unsigned txs)
+    tx_signed: float = 0.0
+    tx_garbage: float = 0.0
 
     def validate(self, n_nodes: int) -> None:
         if self.op not in OPS:
@@ -105,6 +111,12 @@ class Perturbation:
                     f"not {self.action!r}")
             if self.tx_rate <= 0:
                 raise ValueError("overload tx_rate must be positive")
+            if not (0.0 <= self.tx_signed <= 1.0
+                    and 0.0 <= self.tx_garbage <= 1.0
+                    and self.tx_signed + self.tx_garbage <= 1.0):
+                raise ValueError(
+                    "overload tx_signed/tx_garbage must be fractions "
+                    "with tx_signed + tx_garbage <= 1")
 
 
 @dataclass
@@ -256,7 +268,7 @@ class Manifest:
                        "abci", "privval", "seed_bootstrap"})
     _PERTURB_KEYS = frozenset({"node", "op", "at_height", "duration",
                                "failpoint", "action", "delay_ms",
-                               "tx_rate"})
+                               "tx_rate", "tx_signed", "tx_garbage"})
     _MISBEHAVIOR_KEYS = frozenset({"node", "spec"})
     _VALUPDATE_KEYS = frozenset({"node", "at_height", "power"})
 
@@ -298,6 +310,8 @@ class Manifest:
                     action=p.get("action", "delay"),
                     delay_ms=float(p.get("delay_ms", 25.0)),
                     tx_rate=float(p.get("tx_rate", 200.0)),
+                    tx_signed=float(p.get("tx_signed", 0.0)),
+                    tx_garbage=float(p.get("tx_garbage", 0.0)),
                 )
                 for p in d.get("perturbations", [])
             ],

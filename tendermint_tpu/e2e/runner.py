@@ -64,9 +64,33 @@ async def wait_progress(sample, done, *, timeout: float = 120.0,
         await asyncio.sleep(0.25)
 
 
+def envelope_mix_tx(i: int, payload: bytes, signer,
+                    signed_frac: float, garbage_frac: float) -> bytes:
+    """Deterministic signed/garbage/raw admission-plane mix: tx `i`
+    becomes a structurally valid envelope with a hopeless signature
+    (must die at admission, never reach the app) when
+    ``i%100 < garbage_frac*100``, a validly signed envelope below
+    ``(garbage_frac+signed_frac)*100``, and the raw payload otherwise.
+    One builder shared by `tx_flood` and tools/mempool_bench.py
+    --admission, so the flood and the bench can never diverge on what
+    the mix fractions mean."""
+    from ..types import tx_envelope
+
+    slot = i % 100
+    if slot < garbage_frac * 100:
+        return tx_envelope.encode(signer.pub_key().bytes(), bytes(64),
+                                  payload)
+    if slot < (garbage_frac + signed_frac) * 100:
+        return tx_envelope.sign_tx(signer, payload)
+    return payload
+
+
 async def tx_flood(submit, rate: float, duration: float,
                    prefix: bytes = b"flood",
-                   max_outstanding: int = 256) -> int:
+                   max_outstanding: int = 256,
+                   signed_frac: float = 0.0,
+                   garbage_frac: float = 0.0,
+                   signer=None) -> int:
     """Paced unique-tx flood: fire `submit(tx_bytes)` at `rate` txs/s
     for `duration` seconds, swallowing per-tx errors (429 sheds and
     perturbed nodes are the POINT of the exercise). Pacing is against
@@ -76,10 +100,28 @@ async def tx_flood(submit, rate: float, duration: float,
     overrun, defeating the overload scenario exactly when it bites.
     Returns the number of submissions attempted. Shared by the e2e
     `overload` perturbation (submit = RPC broadcast) and
-    tools/net_stress.py --overload (in-process funnel injection)."""
+    tools/net_stress.py --overload (in-process funnel injection).
+
+    `signed_frac` / `garbage_frac` mix in txs wrapped in
+    types/tx_envelope.py envelopes — validly signed and
+    garbage-signature respectively — so a flood exercises the mempool
+    admission plane's shed path, deterministically interleaved (tx i
+    is garbage when i%100 < garbage*100, signed when below
+    (garbage+signed)*100, raw otherwise)."""
     start = time.monotonic()
     sent = 0
     tasks: set = set()
+    if signed_frac or garbage_frac:
+        from ..crypto.ed25519 import Ed25519PrivKey
+
+        signer = signer or Ed25519PrivKey.from_secret(b"e2e-flood-signer")
+
+    def make_tx(i: int) -> bytes:
+        payload = b"%s-%d-%d" % (prefix, id(submit) & 0xFFFF, i)
+        if signed_frac or garbage_frac:
+            return envelope_mix_tx(i, payload, signer,
+                                   signed_frac, garbage_frac)
+        return payload
 
     async def one(tx: bytes) -> None:
         try:
@@ -94,8 +136,7 @@ async def tx_flood(submit, rate: float, duration: float,
             break
         behind = int((now - start) * rate) + 1 - sent
         for _ in range(max(behind, 0)):
-            tx = b"%s-%d-%d" % (prefix, id(submit) & 0xFFFF, sent)
-            t = loop.create_task(one(tx))
+            t = loop.create_task(one(make_tx(sent)))
             tasks.add(t)
             t.add_done_callback(tasks.discard)
             sent += 1
@@ -735,13 +776,16 @@ class Runner:
 
         before = (await self._debug_get(node, "/metrics")).decode()
         shed_before = self._sum_metric(before, "overload_shed_total")
+        adm_shed_before = self._sum_metric(before, "admission_shed_total")
 
         async def submit(tx: bytes) -> None:
             await self._rpc(node, "broadcast_tx_async",
                             tx=base64.b64encode(tx).decode())
 
         flood = asyncio.get_running_loop().create_task(
-            tx_flood(submit, p.tx_rate, p.duration))
+            tx_flood(submit, p.tx_rate, p.duration,
+                     signed_frac=p.tx_signed,
+                     garbage_frac=p.tx_garbage))
         heights: list[int] = []
         levels: list[str] = []
         bounded = True
@@ -770,6 +814,8 @@ class Runner:
         after = (await self._debug_get(node, "/metrics")).decode()
         shed_delta = self._sum_metric(after, "overload_shed_total") \
             - shed_before
+        adm_shed_delta = self._sum_metric(after, "admission_shed_total") \
+            - adm_shed_before
         # recovery: the overload level must clear once the flood stops
         cleared = False
         for _ in range(60):
@@ -785,6 +831,13 @@ class Runner:
                   "heights": heights, "levels": levels,
                   "shed_delta": shed_delta, "bounded": bounded,
                   "cleared": cleared}
+        if p.tx_garbage > 0:
+            # a garbage-envelope flood MUST move the admission shed
+            # counters — junk dying at the device, not in the app
+            report["admission_shed_delta"] = adm_shed_delta
+            assert adm_shed_delta > 0, (
+                f"overload flood with tx_garbage={p.tx_garbage} moved "
+                "no admission_shed_total counters")
         self.overload_reports.append(report)
         self.log(f"perturb: overload report {report}")
 

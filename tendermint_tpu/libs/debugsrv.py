@@ -171,6 +171,18 @@ class HealthMonitor:
             mp["status"] = "ok"
         checks["mempool"] = mp
 
+        # -- admission: the device pre-verify plane in front of
+        # CheckTx (mempool/admission.py). Present only when a Node
+        # with an enabled plane is attached; sheds are designed
+        # behavior, a saturated pre-verify backlog is degraded. --
+        plane = getattr(getattr(node, "mempool", None),
+                        "admission", None)
+        if plane is not None:
+            try:
+                checks["admission"] = plane.status_check()
+            except Exception:  # pragma: no cover - monitoring guard
+                logger.exception("admission status check failed")
+
         # -- device: is the accelerator serving, and is the verify
         # queue draining? Per-backend circuit-breaker states: ed25519
         # and sr25519 degrade independently. --

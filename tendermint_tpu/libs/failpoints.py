@@ -145,6 +145,13 @@ CATALOG: tuple[FailpointDef, ...] = (
         "bytes; restore must fail the snapshot, not apply them)",
         payload=True),
     FailpointDef(
+        "mempool.admission.verify",
+        "the admission plane's batched tx-signature verification "
+        "launch (mempool/admission.py — device or host backend; "
+        "`delay` models a slow verify so the pre-verify queue backs "
+        "up and sheds, `error` a failed launch that must degrade to "
+        "the host oracle)"),
+    FailpointDef(
         "store.save_block",
         "a block about to be persisted to the block store (one atomic "
         "batch: meta + parts + commits + store state)"),

@@ -425,6 +425,42 @@ class MempoolMetrics:
 
 
 @dataclass
+class AdmissionMetrics:
+    """Device-offloaded tx admission plane (mempool/admission.py):
+    the micro-batch collector in front of CheckTx. Occupancy and lane
+    histograms show whether floods actually coalesce into wide device
+    launches; the shed counter (by reason) is the evidence that junk
+    dies at the device, not in the app."""
+    batch_lanes: Histogram = field(default_factory=lambda: DEFAULT.histogram(
+        "batch_lanes",
+        "Txs per admission pre-verify flush (device or host).",
+        "admission",
+        buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512)))
+    batch_occupancy: Histogram = field(
+        default_factory=lambda: DEFAULT.histogram(
+            "batch_occupancy_ratio",
+            "Flush size / configured admission batch size.", "admission",
+            buckets=(0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0)))
+    verify_seconds: Histogram = field(
+        default_factory=lambda: DEFAULT.histogram(
+            "verify_seconds",
+            "Wall time of one admission batch-verify launch.",
+            "admission"))
+    admitted: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "admitted_total",
+        "Txs past signature pre-verification, by signed=yes|no.",
+        "admission"))
+    sheds: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "shed_total",
+        "Txs shed at admission before any ABCI round trip, by reason "
+        "(bad_signature/malformed/unsigned/queue_full).", "admission"))
+    launches: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "verify_launches_total",
+        "Admission batch-verify launches, by backend "
+        "(device/host/host_recheck).", "admission"))
+
+
+@dataclass
 class BlockchainMetrics:
     """Fast-sync pool instrumentation (reference has no blocksync
     metrics in v0.34; names follow the pool's own vocabulary)."""
@@ -684,6 +720,10 @@ def mempool_metrics() -> MempoolMetrics:
     return _singleton("mempool", MempoolMetrics)
 
 
+def admission_metrics() -> AdmissionMetrics:
+    return _singleton("admission", AdmissionMetrics)
+
+
 def blockchain_metrics() -> BlockchainMetrics:
     return _singleton("blockchain", BlockchainMetrics)
 
@@ -741,6 +781,7 @@ class NodeMetrics:
     crypto: CryptoMetrics
     p2p: P2PMetrics
     mempool: MempoolMetrics
+    admission: AdmissionMetrics
     blockchain: BlockchainMetrics
     statesync: StateSyncMetrics
     evidence: EvidenceMetrics
@@ -761,6 +802,7 @@ def node_metrics() -> NodeMetrics:
     return NodeMetrics(
         consensus=consensus_metrics(), crypto=crypto_metrics(),
         p2p=p2p_metrics(), mempool=mempool_metrics(),
+        admission=admission_metrics(),
         blockchain=blockchain_metrics(), statesync=statesync_metrics(),
         evidence=evidence_metrics(), state=state_metrics(),
         abci=abci_metrics(), tpu=tpu_metrics(),

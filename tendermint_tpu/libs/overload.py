@@ -45,6 +45,8 @@ QUEUES = (
     "consensus.funnel.data",    # low-priority funnel (parts / catchup)
     "consensus.vote_buf",       # vote micro-batch verify buffer
     "mempool.pool",             # CheckTx admission (pool + app window)
+    "mempool.preverify",        # admission-plane signature pre-verify
+
     "rpc.http",                 # JSON-RPC in-flight request window
     "rpc.ws_events",            # websocket client event queue
     "p2p.send",                 # per-peer channel send queues (aggregate)

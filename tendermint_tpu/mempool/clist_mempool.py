@@ -25,6 +25,7 @@ from ..abci import types as abci
 from ..config import MempoolConfig
 from ..libs.clist import CList
 from ..libs.overload import CONTROLLER
+from ..types import tx_envelope
 from ..types.tx import tx_hash
 from . import Mempool
 
@@ -119,6 +120,15 @@ class CListMempool(Mempool):
         self._notify_available: asyncio.Event = asyncio.Event()
         if config.wal_dir:
             self._open_wal(config.wal_dir)
+        # Device-offloaded signature pre-verification in front of
+        # CheckTx (mempool/admission.py): EVERY entry path — RPC
+        # broadcast, p2p gossip, WAL replay — converges on check_tx,
+        # so wiring the plane here covers them all.
+        self.admission = None
+        if getattr(config, "admission", "off") not in ("", "off"):
+            from .admission import AdmissionPlane
+
+            self.admission = AdmissionPlane(config)
         CONTROLLER.register("mempool.pool", self.size,
                             lambda: self.config.size, owner=self)
 
@@ -130,11 +140,16 @@ class CListMempool(Mempool):
     def tx_bytes(self) -> int:
         return self._tx_bytes
 
-    def admission_error(self, tx_len: int = 0) -> Exception | None:
+    def admission_error(self, tx_len: int = 0,
+                        tx: bytes | None = None) -> Exception | None:
         """The exception admission control would raise for a tx of
         `tx_len` bytes right now, or None to admit — the ONE place
         the full/busy distinction is made (check_tx raises it; the
-        RPC broadcast preflight maps it to a 429)."""
+        RPC broadcast preflight maps it to a 429). With the tx bytes
+        in hand, the pre-verify-backlog check applies only to
+        ENVELOPED txs: an unsigned tx never enters that queue, so a
+        garbage-envelope flood pinning the backlog full must not 429
+        legitimate unsigned traffic whose own path is idle."""
         if (self.size() >= self.config.size
                 or self._tx_bytes + tx_len > self.config.max_txs_bytes):
             return MempoolFullError(self.size(), self._tx_bytes)
@@ -146,7 +161,29 @@ class CListMempool(Mempool):
                 # shed EXPLICITLY instead of queueing behind a CheckTx
                 # backlog the device-bound host cannot drain
                 return MempoolBusyError(in_flight, max_if)
+        if (self.admission is not None and self.admission.saturated()
+                and (tx is None or tx_envelope.is_enveloped(tx))):
+            from .admission import AdmissionQueueFullError
+
+            c = self.admission.collector
+            return AdmissionQueueFullError(c.depth(), c.queue_max)
         return None
+
+    def shed_admission_error(self, err: Exception) -> None:
+        """Controller/metrics bookkeeping for a tx shed on an
+        admission_error() verdict — one routing for the sync
+        (check_tx) and fire-and-forget (RPC preflight) paths, so both
+        move identical counters: a pre-verify-backlog shed charges
+        `mempool.preverify` (and the plane's queue_full tally), every
+        other reject charges `mempool.pool`."""
+        from .admission import AdmissionQueueFullError
+
+        if isinstance(err, AdmissionQueueFullError):
+            if self.admission is not None:
+                self.admission.count_queue_full_shed()
+            CONTROLLER.shed("mempool.preverify")
+        else:
+            CONTROLLER.shed("mempool.pool")
 
     def overloaded(self) -> bool:
         return self.admission_error() is not None
@@ -188,6 +225,45 @@ class CListMempool(Mempool):
             i += 4 + ln
         return out
 
+    async def refill_from_wal(self) -> dict:
+        """Re-admit WAL-recorded txs through the FULL check_tx path —
+        admission pre-verification included — so a restart can never
+        re-admit a tx that would now fail signature verification (or
+        the strict unsigned policy). Rejected txs are compacted out of
+        the WAL at the end; the report feeds the startup log."""
+        txs = self.wal_pending_txs()
+        report = {"pending": len(txs), "readmitted": 0, "rejected": 0}
+        # bounded-concurrency re-admission: serial awaits would make
+        # every enveloped tx pay its own admission flush deadline and
+        # a 1-lane host verify — concurrent submissions coalesce into
+        # the wide device batches the plane exists for, and overlap
+        # the ABCI round trips. The cap stays safely below the
+        # pre-verify queue bound and the CheckTx in-flight window so
+        # the refill can never shed ITSELF as transient overload.
+        conc = 64
+        if self.admission is not None:
+            conc = min(conc, self.admission.collector.queue_max)
+        if self.config.checktx_max_inflight:
+            conc = min(conc, self.config.checktx_max_inflight)
+        sem = asyncio.Semaphore(max(1, conc))
+
+        async def readmit(tx: bytes) -> bool:
+            async with sem:
+                try:
+                    res = await self.check_tx(tx)
+                    return getattr(res, "code", 1) == abci.CODE_TYPE_OK
+                except Exception as e:
+                    logger.debug("WAL refill tx rejected: %s", e)
+                    return False
+
+        for ok in await asyncio.gather(*(readmit(tx) for tx in txs)):
+            report["readmitted" if ok else "rejected"] += 1
+        if txs:
+            # compact: the on-disk pending set must match the pool, so
+            # a rejected tx does not resurface on the NEXT restart
+            self._rewrite_wal()
+        return report
+
     def _rewrite_wal(self) -> None:
         """Compact the WAL to the current pending set (runs per block,
         not per tx — so the file is the pending set, not a history).
@@ -220,6 +296,8 @@ class CListMempool(Mempool):
         """Teardown: drop the WAL handle and the overload
         registration (owner-checked — a newer pool's entry survives)."""
         self.close_wal()
+        if self.admission is not None:
+            self.admission.close()
         CONTROLLER.unregister("mempool.pool", owner=self)
 
     # --- CheckTx admission ---------------------------------------------------
@@ -237,9 +315,9 @@ class CListMempool(Mempool):
             err = self.precheck(tx)
             if err is not None:
                 raise ValueError(f"precheck: {err}")
-        admission_err = self.admission_error(len(tx))
+        admission_err = self.admission_error(len(tx), tx)
         if admission_err is not None:
-            CONTROLLER.shed("mempool.pool")
+            self.shed_admission_error(admission_err)
             raise admission_err
 
         key = tx_hash(tx)
@@ -250,6 +328,35 @@ class CListMempool(Mempool):
             if e is not None and tx_info and tx_info.get("sender"):
                 e.value.senders.add(tx_info["sender"])
             raise TxInMempoolError("tx already in cache")
+
+        # Signature pre-verification BEFORE the app round trip: a tx
+        # shed here costs the app NOTHING (the acceptance test counts
+        # the app's CheckTx calls under a garbage flood: zero). The
+        # cache key above is the hash of the FULL envelope bytes, so a
+        # bad-signature shed can never poison a later, correctly
+        # signed envelope carrying the same payload — but the shed
+        # entry itself is dropped (unless the operator keeps invalid
+        # txs cached) so the identical envelope re-verifies.
+        if self.admission is not None:
+            from .admission import (CODE_ADMISSION_REJECT,
+                                    AdmissionQueueFullError)
+
+            try:
+                shed_reason = await self.admission.admit(tx)
+            except AdmissionQueueFullError:
+                # transient backpressure, not a verdict: never leave a
+                # cache entry that would blackhole the retry
+                self.cache.remove(key)
+                raise
+            if shed_reason is not None:
+                if not self.config.keep_invalid_txs_in_cache:
+                    self.cache.remove(key)
+                from ..libs.metrics import mempool_metrics
+
+                mempool_metrics().failed_txs.inc()
+                return abci.ResponseCheckTx(
+                    code=CODE_ADMISSION_REJECT,
+                    log=f"admission: {shed_reason}")
 
         gen_before = self._update_gen
         res = await self.client.check_tx(abci.RequestCheckTx(tx=tx))

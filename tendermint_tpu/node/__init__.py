@@ -219,6 +219,13 @@ class Node(Service):
                 block_indexer=self.block_indexer)
         self.mempool = CListMempool(cfg.mempool, self.proxy_app.mempool,
                                     height=self.state.last_block_height)
+        if cfg.mempool.wal_dir:
+            # Refill through the FULL admission path (signature
+            # pre-verification included): a restart must not re-admit
+            # txs the admission plane would now shed.
+            refill = await self.mempool.refill_from_wal()
+            if refill["pending"]:
+                logger.info("mempool WAL refill: %s", refill)
         self.block_exec = BlockExecutor(
             self.state_store, self.proxy_app.consensus,
             mempool=self.mempool, evidence_pool=self.evpool,

@@ -479,15 +479,14 @@ class Environment:
         # could slow down.
         mp = self.node.mempool
         admission_err = getattr(mp, "admission_error",
-                                lambda n=0: None)(len(raw))
+                                lambda n=0, tx=None: None)(len(raw), raw)
         if admission_err is not None:
             # count the shed here: the CheckTx task that would have
             # recorded it is never spawned, and a flood rejected only
             # on this path must still move overload_shed_total and
-            # the /status level (parity with broadcast_tx_sync)
-            from ..libs.overload import CONTROLLER
-
-            CONTROLLER.shed("mempool.pool")
+            # the /status level (parity with broadcast_tx_sync) —
+            # same routing as check_tx via shed_admission_error
+            mp.shed_admission_error(admission_err)
             raise self._busy_error(admission_err)
         # hold a strong ref: the loop only weak-refs tasks, and a GC'd
         # task would silently drop the tx
@@ -505,13 +504,15 @@ class Environment:
             return e
 
     async def broadcast_tx_sync(self, ctx, tx="") -> dict:
+        from ..mempool.admission import AdmissionQueueFullError
         from ..mempool.clist_mempool import MempoolBusyError, \
             MempoolFullError
 
         raw = _tx_bytes(tx)
         try:
             res = await self.node.mempool.check_tx(raw)
-        except (MempoolBusyError, MempoolFullError) as e:
+        except (MempoolBusyError, MempoolFullError,
+                AdmissionQueueFullError) as e:
             raise self._busy_error(e) from e
         except Exception as e:
             raise RPCError(-32603, f"tx rejected: {e}") from e
@@ -573,12 +574,14 @@ class Environment:
         subscriber = f"tx-commit-{h.hex()[:16]}"
         sub = bus.subscribe(subscriber, query_for_event("Tx"))
         try:
+            from ..mempool.admission import AdmissionQueueFullError
             from ..mempool.clist_mempool import MempoolBusyError, \
                 MempoolFullError
 
             try:
                 check = await self.node.mempool.check_tx(raw)
-            except (MempoolBusyError, MempoolFullError) as e:
+            except (MempoolBusyError, MempoolFullError,
+                    AdmissionQueueFullError) as e:
                 raise self._busy_error(e) from e
             except Exception as e:
                 raise RPCError(-32603, f"tx rejected: {e}") from e

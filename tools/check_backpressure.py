@@ -61,6 +61,36 @@ def collect_problems() -> list[str]:
             problems.append(
                 f"{name}: missing from the libs/metrics.py catalog — "
                 "tracked queues cannot export depth/shed without it")
+    # 1b. the admission plane's own shed surface: every reason string
+    # counted at a `sheds.inc(reason=...)` / `_shed(...)` call site
+    # must come from the closed SHED_REASONS set, and the admission
+    # metric family must exist (the per-reason counter is the evidence
+    # a flood died at the device, not in the app)
+    from tendermint_tpu.mempool import admission as adm
+
+    for name in ("admission_shed_total", "admission_batch_lanes",
+                 "admission_verify_launches_total"):
+        if name not in declared:
+            problems.append(
+                f"{name}: missing from the libs/metrics.py catalog — "
+                "the admission plane cannot prove its sheds without it")
+    # anchored on the admission counter / helper call shapes only —
+    # a bare `reason=...` kwarg belongs to OTHER metric families
+    # (e.g. rpc requests_rejected) and must not be dragged into the
+    # admission reason set
+    reason_re = re.compile(
+        r"""(?:\bsheds\.inc\(\s*reason\s*=\s*|\b_shed\(\s*)"""
+        r"""(?:"([a-z_]+)"|(SHED_[A-Z_]+))""")
+    for rel, text in _product_sources():
+        for m in reason_re.finditer(text):
+            lit, sym = m.group(1), m.group(2)
+            reason = lit if lit is not None else \
+                getattr(adm, sym, None)
+            if reason not in adm.SHED_REASONS:
+                problems.append(
+                    f"{rel}: admission shed reason {lit or sym!r} not "
+                    "in the closed mempool/admission.py SHED_REASONS "
+                    "set")
 
     # 2. catalog <-> call sites
     used: dict[str, list[str]] = {}

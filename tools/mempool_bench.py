@@ -68,12 +68,98 @@ def bench_cache(n: int) -> tuple[float, float]:
     return n / t_push, n / t_rm
 
 
+async def bench_admission(n: int, signed_frac: float = 0.2,
+                          garbage_frac: float = 0.3,
+                          batch: int = 256, flush_ms: float = 2.0):
+    """Flood a pool with the admission plane enabled: a deterministic
+    mix of validly signed envelopes, garbage-signature envelopes and
+    raw unsigned txs, submitted concurrently so the micro-batch
+    collector actually coalesces. Reports admitted/shed rates and the
+    device/host batch occupancy from the admission metric deltas."""
+    from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+    from tendermint_tpu.e2e.runner import envelope_mix_tx
+    from tendermint_tpu.libs import metrics as libmetrics
+
+    pool = CListMempool(
+        MempoolConfig(size=n + 10, cache_size=n + 10, recheck=False,
+                      admission="permissive", admission_batch=batch,
+                      admission_flush_ms=flush_ms,
+                      admission_queue=max(2048, n)),
+        LocalClient(KVStoreApp()))
+    signer = Ed25519PrivKey.from_secret(b"mempool-bench-admission")
+    txs = [envelope_mix_tx(i, b"bench-%d" % i, signer,
+                           signed_frac, garbage_frac)
+           for i in range(n)]
+
+    async def submit(tx: bytes):
+        try:
+            return await pool.check_tx(tx)
+        except Exception as e:
+            return e
+
+    before = libmetrics.snapshot()
+    t0 = time.perf_counter()
+    # bounded concurrency: enough in flight to fill batches, not so
+    # much that the pre-verify queue bound itself becomes the bench
+    sem = asyncio.Semaphore(512)
+
+    async def one(tx: bytes):
+        async with sem:
+            return await submit(tx)
+
+    await asyncio.gather(*(one(tx) for tx in txs))
+    dt = time.perf_counter() - t0
+    d = libmetrics.delta(before, libmetrics.snapshot())
+    pool.close()
+    return n / dt, pool.size(), d
+
+
+def _admission_report(rate: float, pool_size: int, d: dict,
+                      n: int) -> None:
+    admitted = sum(v for k, v in d.items()
+                   if k.startswith("admission_admitted_total"))
+    shed = {k.split('reason="')[1].rstrip('"}'): int(v)
+            for k, v in d.items()
+            if k.startswith("admission_shed_total")}
+    launches = {k.split('backend="')[1].rstrip('"}'): int(v)
+                for k, v in d.items()
+                if k.startswith("admission_verify_launches_total")}
+    lanes = d.get("admission_batch_lanes", {})
+    occ = d.get("admission_batch_occupancy_ratio", {})
+    print(f"admission bench @ {n} txs "
+          f"(kvstore app, local ABCI client, admission=permissive)")
+    print(f"  throughput          {rate:12,.0f} tx/s")
+    print(f"  admitted → pool     {int(admitted):8d} ({pool_size} pooled)")
+    print(f"  shed                {shed}")
+    print(f"  verify launches     {launches}")
+    if lanes:
+        print(f"  batch lanes         count={lanes['count']} "
+              f"p50={lanes['p50']:.1f} p95={lanes['p95']:.1f}")
+    if occ:
+        print(f"  batch occupancy     p50={occ['p50']:.3f} "
+              f"p95={occ['p95']:.3f}")
+
+
 def main():
     import argparse
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--size", type=int, default=10_000)
-    n = ap.parse_args().size
+    ap.add_argument("--admission", action="store_true",
+                    help="bench the signature pre-verification plane "
+                    "(signed/garbage/unsigned mix) instead of the "
+                    "classic four surfaces")
+    ap.add_argument("--signed", type=float, default=0.2,
+                    help="fraction of validly signed envelope txs")
+    ap.add_argument("--garbage", type=float, default=0.3,
+                    help="fraction of garbage-signature envelope txs")
+    args = ap.parse_args()
+    n = args.size
+    if args.admission:
+        rate, pooled, d = asyncio.run(
+            bench_admission(n, args.signed, args.garbage))
+        _admission_report(rate, pooled, d, n)
+        return
     check_rate = asyncio.run(bench_check_tx(n))
     reap_p50 = asyncio.run(bench_reap(n))
     push_rate, rm_rate = bench_cache(n)
