@@ -192,6 +192,43 @@ class MempoolConfig:
 
 
 @dataclass
+class LightConfig:
+    """Light-client serving plane (light/serving.py; this framework's
+    addition — the reference light proxy verifies per request with no
+    cross-request sharing). Knobs for the shared verification plane a
+    LightProxy / ServingPool runs requests through."""
+
+    # verified-header LRU entries (trusting-period-aware; a second
+    # client hitting a cached height costs a dict lookup, not a
+    # device launch)
+    cache_size: int = 4096
+    # coalesced verify launches flush at this many signature lanes ...
+    batch_max: int = 1024
+    # ... or this many ms after the first pending check, whichever
+    # comes first (the admission-collector window shape)
+    flush_ms: float = 2.0
+    # pending-verify backlog bound (parked + in-verify commit checks);
+    # the newest REQUEST is shed with a 429-style error when full.
+    # Floor of 2: one non-adjacent verification parks TWO concurrent
+    # commit checks, so pending_max=1 would deterministically shed
+    # every skipping verify on an otherwise idle plane
+    pending_max: int = 1024
+    # ServingPool proxy workers sharing one plane
+    workers: int = 2
+
+    def validate_basic(self) -> None:
+        for name in ("cache_size", "batch_max", "workers"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"light.{name} must be positive")
+        if self.pending_max < 2:
+            raise ValueError(
+                "light.pending_max must be >= 2 (a non-adjacent "
+                "verification parks two concurrent commit checks)")
+        if self.flush_ms < 0:
+            raise ValueError("negative light.flush_ms")
+
+
+@dataclass
 class StateSyncConfig:
     enable: bool = False
     rpc_servers: list[str] = field(default_factory=list)
@@ -339,6 +376,7 @@ class Config:
     rpc: RPCConfig = field(default_factory=RPCConfig)
     p2p: P2PConfig = field(default_factory=P2PConfig)
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    light: LightConfig = field(default_factory=LightConfig)
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     fastsync: FastSyncConfig = field(default_factory=FastSyncConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
@@ -353,6 +391,7 @@ class Config:
         self.rpc.validate_basic()
         self.p2p.validate_basic()
         self.mempool.validate_basic()
+        self.light.validate_basic()
         self.statesync.validate_basic()
         self.fastsync.validate_basic()
         self.consensus.validate_basic()
@@ -365,9 +404,9 @@ class Config:
         import dataclasses
 
         lines = []
-        for section_name in ("base", "rpc", "p2p", "mempool", "statesync",
-                             "fastsync", "consensus", "tx_index",
-                             "instrumentation", "chaos"):
+        for section_name in ("base", "rpc", "p2p", "mempool", "light",
+                             "statesync", "fastsync", "consensus",
+                             "tx_index", "instrumentation", "chaos"):
             section = getattr(self, section_name)
             lines.append(f"[{section_name}]")
             for f in dataclasses.fields(section):

@@ -81,6 +81,7 @@ def generate(rng: random.Random) -> Manifest:
     for i in range(perturbable):
         if rng.random() < 0.35:
             op = rng.choice(ops)
+            at_height = rng.randint(2, max(2, wait_height - 2))
             kwargs = {}
             if op == "kill" and rng.random() < 0.5:
                 kwargs = {"failpoint": rng.choice(kill_points)}
@@ -101,10 +102,14 @@ def generate(rng: random.Random) -> Manifest:
                         or rng.random() < 0.5:
                     kwargs["tx_garbage"] = rng.choice((0.2, 0.5))
                     kwargs["tx_signed"] = rng.choice((0.0, 0.1))
+            elif op == "light_proxy":
+                # the serving plane needs a few committed heights to
+                # fan out over (manifest floor: at_height >= 4)
+                at_height = max(at_height, 4)
             m.perturbations.append(Perturbation(
                 node=i,
                 op=op,
-                at_height=rng.randint(2, max(2, wait_height - 2)),
+                at_height=at_height,
                 duration=round(rng.uniform(1.0, 4.0), 1),
                 **kwargs,
             ))

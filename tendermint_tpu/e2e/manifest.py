@@ -39,9 +39,15 @@ from dataclasses import dataclass, field
 # (default device.verify) throttles its hot path — liveness under
 # overload as an asserted invariant: heights keep advancing, shed
 # counters climb, bounded queues stay bounded, and the /status
-# overload level clears after the window
+# overload level clears after the window;
+# light_proxy = boot an in-runner light serving plane + proxy
+# (light/serving.py) against the node's RPC, fan out concurrent
+# verified header/commit requests with height overlap, and assert
+# coalescing (verify launches ≪ requests), response parity with the
+# primary, and 429 shed-newest under a light.verify-delay flood while
+# the backing net keeps committing
 OPS = ("kill", "pause", "disconnect", "disconnect_hard", "restart",
-       "chaos", "overload")
+       "chaos", "overload", "light_proxy")
 
 
 @dataclass
@@ -97,6 +103,11 @@ class Perturbation:
                 raise ValueError(
                     f"chaos action must be error|delay|corrupt, "
                     f"not {self.action!r}")
+        if self.op == "light_proxy":
+            if self.at_height < 4:
+                # the plane needs a few committed heights to fan out
+                # over (trust root at 1 + an overlap window above it)
+                raise ValueError("light_proxy at_height must be >= 4")
         if self.op == "overload":
             from ..libs.failpoints import BY_NAME
 

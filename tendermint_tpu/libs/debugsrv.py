@@ -183,6 +183,20 @@ class HealthMonitor:
             except Exception:  # pragma: no cover - monitoring guard
                 logger.exception("admission status check failed")
 
+        # -- light: the light-client serving plane, when one is live
+        # in THIS process (light/serving.py — a LightProxy/ServingPool
+        # host, not a validator). Consulted only if the module is
+        # already imported: a plane can only exist then, and an
+        # ordinary node's /status poll must not pay the import. --
+        mod = sys.modules.get("tendermint_tpu.light.serving")
+        if mod is not None:
+            plane = mod.active_plane()
+            if plane is not None:
+                try:
+                    checks["light"] = plane.status_check()
+                except Exception:  # pragma: no cover - monitor guard
+                    logger.exception("light status check failed")
+
         # -- device: is the accelerator serving, and is the verify
         # queue draining? Per-backend circuit-breaker states: ed25519
         # and sr25519 degrade independently. --

@@ -152,6 +152,14 @@ CATALOG: tuple[FailpointDef, ...] = (
         "up and sheds, `error` a failed launch that must degrade to "
         "the host oracle)"),
     FailpointDef(
+        "light.verify",
+        "the light serving plane's coalesced header-commit "
+        "verification launch (light/serving.py — device or host "
+        "backend; `delay` models a slow verify so the pending-verify "
+        "queue backs up and sheds requests with 429s, `error` a "
+        "failed launch that must degrade to the host oracle, never "
+        "fail the requests)"),
+    FailpointDef(
         "store.save_block",
         "a block about to be persisted to the block store (one atomic "
         "batch: meta + parts + commits + store state)"),

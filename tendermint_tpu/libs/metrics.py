@@ -461,6 +461,43 @@ class AdmissionMetrics:
 
 
 @dataclass
+class LightMetrics:
+    """Light-client serving plane (light/serving.py): the shared
+    verification plane between the proxy RPC surface and the light
+    client. Lanes-per-launch and the coalesce/cache counters are the
+    evidence that N concurrent client requests collapse into few wide
+    device launches; the shed counter is the evidence a request flood
+    dies at the plane, not in the event loop."""
+    batch_lanes: Histogram = field(default_factory=lambda: DEFAULT.histogram(
+        "batch_lanes",
+        "Signature lanes per coalesced light-verify launch.", "light",
+        buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)))
+    verify_seconds: Histogram = field(
+        default_factory=lambda: DEFAULT.histogram(
+            "verify_seconds",
+            "Wall time of one coalesced light-verify launch.", "light"))
+    verify_launches: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "verify_launches_total",
+        "Light-plane batch-verify launches, by backend "
+        "(device/host/host_recheck).", "light"))
+    cache_hits: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "cache_hits_total",
+        "Requests served from the verified-header cache.", "light"))
+    cache_misses: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "cache_misses_total",
+        "Requests that missed the verified-header cache.", "light"))
+    requests_coalesced: Counter = field(
+        default_factory=lambda: DEFAULT.counter(
+            "requests_coalesced_total",
+            "Requests that joined an in-flight verification for the "
+            "same height instead of starting their own.", "light"))
+    shed: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "shed_total",
+        "Requests shed at the serving plane, by reason (queue_full).",
+        "light"))
+
+
+@dataclass
 class BlockchainMetrics:
     """Fast-sync pool instrumentation (reference has no blocksync
     metrics in v0.34; names follow the pool's own vocabulary)."""
@@ -724,6 +761,10 @@ def admission_metrics() -> AdmissionMetrics:
     return _singleton("admission", AdmissionMetrics)
 
 
+def light_metrics() -> LightMetrics:
+    return _singleton("light", LightMetrics)
+
+
 def blockchain_metrics() -> BlockchainMetrics:
     return _singleton("blockchain", BlockchainMetrics)
 
@@ -782,6 +823,7 @@ class NodeMetrics:
     p2p: P2PMetrics
     mempool: MempoolMetrics
     admission: AdmissionMetrics
+    light: LightMetrics
     blockchain: BlockchainMetrics
     statesync: StateSyncMetrics
     evidence: EvidenceMetrics
@@ -802,7 +844,7 @@ def node_metrics() -> NodeMetrics:
     return NodeMetrics(
         consensus=consensus_metrics(), crypto=crypto_metrics(),
         p2p=p2p_metrics(), mempool=mempool_metrics(),
-        admission=admission_metrics(),
+        admission=admission_metrics(), light=light_metrics(),
         blockchain=blockchain_metrics(), statesync=statesync_metrics(),
         evidence=evidence_metrics(), state=state_metrics(),
         abci=abci_metrics(), tpu=tpu_metrics(),

@@ -46,6 +46,7 @@ QUEUES = (
     "consensus.vote_buf",       # vote micro-batch verify buffer
     "mempool.pool",             # CheckTx admission (pool + app window)
     "mempool.preverify",        # admission-plane signature pre-verify
+    "light.pending_verify",     # light serving plane verify backlog
 
     "rpc.http",                 # JSON-RPC in-flight request window
     "rpc.ws_events",            # websocket client event queue

@@ -17,6 +17,13 @@ from .errors import (
     VerificationFailedError,
 )
 from .provider import BlockStoreProvider, Provider
+from .serving import (
+    LightServingShedError,
+    LightVerifyCollector,
+    ServingPlane,
+    ServingPool,
+    VerifiedHeaderCache,
+)
 from .store import LightStore
 from .types import LightBlock, SignedHeader
 from .verifier import (
@@ -28,6 +35,8 @@ from .verifier import (
 __all__ = [
     "Client", "TrustOptions", "LightBlock", "SignedHeader",
     "LightStore", "Provider", "BlockStoreProvider",
+    "ServingPlane", "ServingPool", "VerifiedHeaderCache",
+    "LightVerifyCollector", "LightServingShedError",
     "verify_adjacent", "verify_non_adjacent", "DEFAULT_TRUST_LEVEL",
     "LightClientError", "VerificationFailedError",
     "NewValSetCantBeTrustedError", "DivergenceError",

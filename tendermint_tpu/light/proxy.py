@@ -20,6 +20,7 @@ from ..rpc.jsonrpc import JSONRPCServer, RPCError
 from .client import Client
 from .errors import LightClientError
 from .provider import BlockNotFoundError
+from .serving import LightServingShedError
 
 logger = logging.getLogger("light.proxy")
 
@@ -34,9 +35,15 @@ class LightProxy:
     """
 
     def __init__(self, client: Client, forward_client=None,
-                 proof_runtime=None):
+                 proof_runtime=None, plane=None):
         self.client = client
         self.forward = forward_client
+        # Shared verification plane (light/serving.py ServingPlane):
+        # when set, every verified route resolves heights through it —
+        # request coalescing, the verified-header cache and batched
+        # commit verification — instead of walking the client
+        # serially. Several proxy workers (ServingPool) share one.
+        self.plane = plane
         # app-defined proof formats decode through this registry
         # (reference: lrpc.KeyPathFn/prt options); default knows the
         # kvstore ops, apps with their own formats inject a runtime
@@ -90,14 +97,22 @@ class LightProxy:
     # -- verified routes --
 
     async def _verified_block_at(self, height) -> "object":
+        from ..rpc.jsonrpc import CODE_BUSY
+
         h = int(height) if height else 0
         try:
-            if h == 0:
+            if self.plane is not None:
+                lb = await self.plane.get_verified(h)
+            elif h == 0:
                 lb = await self.client.update()
                 if lb is None:
                     lb = self.client.trusted_light_block()
             else:
                 lb = await self.client.verify_light_block_at_height(h)
+        except LightServingShedError as e:
+            # backpressure, not a verdict: same 429 vocabulary as the
+            # RPC overload limiter and the mempool admission sheds
+            raise RPCError(CODE_BUSY, str(e), "queue_full")
         except (LightClientError, BlockNotFoundError) as e:
             raise RPCError(-32603, f"light verification failed: {e}")
         if lb is None:
@@ -446,7 +461,11 @@ class LightProxy:
         deadline = asyncio.get_running_loop().time() + 5.0
         while True:
             try:
-                lb = await self.client.verify_light_block_at_height(h + 1)
+                if self.plane is not None:
+                    lb = await self.plane.get_verified(h + 1)
+                else:
+                    lb = await self.client.verify_light_block_at_height(
+                        h + 1)
                 break
             except BlockNotFoundError as e:
                 if asyncio.get_running_loop().time() >= deadline:
@@ -454,6 +473,13 @@ class LightProxy:
                         -32603, f"header {h + 1} (carrying the app "
                         f"hash for query height {h}) not available: {e}")
                 await asyncio.sleep(0.2)
+            except LightServingShedError as e:
+                # same shed-to-429 mapping as _verified_block_at:
+                # backpressure, not a verdict (clause order matters —
+                # the shed error IS a LightClientError)
+                from ..rpc.jsonrpc import CODE_BUSY
+
+                raise RPCError(CODE_BUSY, str(e), "queue_full")
             except LightClientError as e:
                 raise RPCError(-32603, f"light verification failed: {e}")
         app_hash = lb.signed_header.header.app_hash

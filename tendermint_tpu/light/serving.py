@@ -1,0 +1,807 @@
+"""Light-client serving plane (ROADMAP item 4; no reference
+equivalent — the reference light proxy walks the client serially, one
+commit-verify per bisection pivot per request).
+
+A skipping verify is two >1/3-power commit checks — exactly the shape
+the batched verify kernel already accelerates — yet a proxy serving N
+concurrent read-mostly clients used to pay N independent serial
+verification walks. The ServingPlane here sits between the LightProxy
+RPC surface (one or many workers — ServingPool) and the light
+``Client`` and turns N concurrent requests into few wide launches:
+
+  * **request coalescing + verified-header cache** — a singleflight
+    map keyed by height makes concurrent requests for the same height
+    pay ONE verification, and a trusting-period-aware in-memory LRU
+    over the trusted ``LightStore`` makes the second client hitting a
+    verified height cost a dict lookup, not a device launch;
+
+  * **batched skipping verify** — a micro-batching collector (the
+    ``mempool/admission.py`` flush-on-size-or-deadline shape) takes
+    ``types/validator_set.py`` CommitVerifyPlans from independent
+    requests AND from both checks of one bisection step (the trusted
+    -overlap check and the new set's own +2/3 check run concurrently)
+    and executes them as single wide ed25519 launches — breaker-aware
+    with host fallback, one known-answer sentinel lane per device
+    batch (a NaN-ing kernel fails the sentinel and the batch re-runs
+    on host instead of failing requests on wrong verdicts);
+
+  * **bounded pending-verify backlog** — the collector's parked +
+    in-verify commit checks are the ``light.pending_verify`` entry in
+    the overload QUEUES catalog: at the bound the NEWEST request is
+    shed with a 429-style error, so a request flood dies at the
+    plane, not in the event loop (and never behind a wedged device —
+    the ``light.verify`` failpoint's `delay` shape is the proof).
+
+The plane preserves the Client's verification semantics exactly —
+same bisection pivots, same error taxonomy, same witness
+cross-checking after the target verifies, same trusted-store writes —
+only the signature work is pooled.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+import time
+
+import numpy as np
+
+from ..libs.overload import CONTROLLER
+from ..types.validator_set import CommitVerifyPlan, VerificationError
+from .errors import (
+    DivergenceError,
+    LightClientError,
+    NewValSetCantBeTrustedError,
+    OutsideTrustingPeriodError,
+    VerificationFailedError,
+)
+from .types import LightBlock
+
+logger = logging.getLogger("light.serving")
+
+PENDING_VERIFY_QUEUE = "light.pending_verify"
+
+# Shed reasons — the closed label set of light_shed_total
+# (tools/check_backpressure.py lints call sites against it).
+SHED_QUEUE_FULL = "queue_full"
+SHED_REASONS = (SHED_QUEUE_FULL,)
+
+
+class LightServingShedError(LightClientError):
+    """Pending-verify backlog full: the newest request is shed (429 at
+    the proxy) — transient backpressure, NOT a verification verdict."""
+
+    def __init__(self, depth: int, limit: int):
+        super().__init__(
+            f"light serving plane overloaded: {depth} commit checks "
+            f"pending (limit {limit}); retry later")
+
+
+# -- the process-global active plane (the /status `light` check) ------
+
+_ACTIVE_PLANE: "ServingPlane | None" = None
+
+
+def active_plane() -> "ServingPlane | None":
+    """The most recently built (not yet closed) plane in this process
+    — what libs/debugsrv.py's HealthMonitor reports under the `light`
+    check. Several in-process test planes replace each other, same
+    stance as the metric/controller singletons."""
+    return _ACTIVE_PLANE
+
+
+class VerifiedHeaderCache:
+    """Trusting-period-aware LRU over verified LightBlocks.
+
+    Backs the trusted LightStore with an O(1) hot path: the store
+    round-trips JSON per get, this returns the live object. Entries
+    whose header time has left the trusting period are evicted on
+    read — a block outside its period must not be served as trusted
+    (its valset may have long unbonded), even though it still sits in
+    the persistent store."""
+
+    def __init__(self, max_entries: int, period_ns: int):
+        self.max_entries = max(1, max_entries)
+        self.period_ns = period_ns
+        self._d: collections.OrderedDict[int, LightBlock] = \
+            collections.OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def get(self, height: int, now_ns: int) -> LightBlock | None:
+        lb = self._d.get(height)
+        if lb is None:
+            return None
+        if lb.time() + self.period_ns <= now_ns:
+            del self._d[height]
+            return None
+        self._d.move_to_end(height)
+        return lb
+
+    def put(self, lb: LightBlock, now_ns: int) -> None:
+        if lb.time() + self.period_ns <= now_ns:
+            return  # already expired: never cache
+        self._d[lb.height()] = lb
+        self._d.move_to_end(lb.height())
+        while len(self._d) > self.max_entries:
+            self._d.popitem(last=False)
+
+    def clear(self) -> None:
+        self._d.clear()
+
+
+class _VerifyJob:
+    __slots__ = ("plan", "future")
+
+    def __init__(self, plan: CommitVerifyPlan, future: asyncio.Future):
+        self.plan = plan
+        self.future = future
+
+
+class LightVerifyCollector:
+    """Micro-batching commit-check collector (the admission-collector
+    shape, but the unit of work is a CommitVerifyPlan of several
+    signature lanes, not one tx).
+
+    ``check(plan)`` parks the plan and awaits its verdict; a single
+    flusher cuts batches once ``batch_max`` LANES have accumulated (or
+    ``flush_ms`` after the first pending plan) and runs every plan's
+    triples through ONE wide verify launch in an executor thread,
+    scattering per-lane verdicts back per plan. A plan with any
+    invalid lane gets the same VerificationError its inline execute()
+    would raise — one request's lying provider never poisons the
+    verdicts of the batchmates."""
+
+    def __init__(self, batch_max: int = 1024, flush_ms: float = 2.0,
+                 pending_max: int = 1024,
+                 device_threshold: int | None = None, controller=None):
+        from ..crypto import batch as cbatch
+
+        self.batch_max = max(1, batch_max)
+        self.flush_ms = flush_ms
+        self.pending_max = max(1, pending_max)
+        self.device_threshold = cbatch._DEVICE_THRESHOLD \
+            if device_threshold is None else device_threshold
+        self._controller = controller or CONTROLLER
+        self._pending: collections.deque[_VerifyJob] = collections.deque()
+        self._pending_lane_count = 0
+        self._in_flight = 0
+        self._item_evt = asyncio.Event()
+        self._full_evt = asyncio.Event()
+        self._flusher: asyncio.Task | None = None
+        self._controller.register("light.pending_verify", self.depth,
+                                  lambda: self.pending_max, owner=self)
+
+    # -- sizes ---------------------------------------------------------
+
+    def depth(self) -> int:
+        """Backlog the bound applies to: parked + in-verify checks."""
+        return len(self._pending) + self._in_flight
+
+    def pending_lanes(self) -> int:
+        # maintained incrementally: check() and the flusher consult
+        # this per enqueue/wakeup, and a scan of a deep backlog here
+        # would make admission quadratic exactly under load
+        return self._pending_lane_count
+
+    def saturated(self) -> bool:
+        return self.depth() >= self.pending_max
+
+    # -- lifecycle -----------------------------------------------------
+
+    def close(self) -> None:
+        if self._flusher is not None:
+            self._flusher.cancel()
+            self._flusher = None
+        for job in self._pending:
+            if not job.future.done():
+                job.future.cancel()
+        self._pending.clear()
+        self._pending_lane_count = 0
+        self._controller.unregister("light.pending_verify", owner=self)
+
+    def _ensure_flusher(self) -> None:
+        if self._flusher is None or self._flusher.done():
+            self._flusher = asyncio.get_running_loop().create_task(
+                self._flush_loop(), name="light-verify-flusher")
+
+    # -- the await-a-verdict entry point -------------------------------
+
+    async def check(self, plan: CommitVerifyPlan) -> None:
+        """Queue `plan` for the next coalesced launch; returns when
+        every lane verified, raises VerificationError (bad slots named
+        exactly like the inline path) otherwise. Raises
+        LightServingShedError (shed-newest) at the backlog bound —
+        UNcounted: one shed REQUEST may park two plans (the gathered
+        checks of a non-adjacent step), so the plane counts sheds once
+        per request, not here per plan."""
+        if self.depth() >= self.pending_max:
+            raise LightServingShedError(self.depth(), self.pending_max)
+        self._ensure_flusher()
+        fut = asyncio.get_running_loop().create_future()
+        self._pending.append(_VerifyJob(plan, fut))
+        self._pending_lane_count += len(plan)
+        self._item_evt.set()
+        if self.pending_lanes() >= self.batch_max:
+            self._full_evt.set()
+        verdicts = await fut
+        plan.raise_invalid(verdicts)
+
+    # -- flusher -------------------------------------------------------
+
+    async def _flush_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            while not self._pending:
+                self._item_evt.clear()
+                await self._item_evt.wait()
+            deadline = loop.time() + self.flush_ms / 1000.0
+            while self.pending_lanes() < self.batch_max:
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    break
+                self._full_evt.clear()
+                try:
+                    await asyncio.wait_for(self._full_evt.wait(),
+                                           remaining)
+                except asyncio.TimeoutError:
+                    break
+            batch: list[_VerifyJob] = []
+            lanes = 0
+            while self._pending and (not batch
+                                     or lanes < self.batch_max):
+                job = self._pending.popleft()
+                self._pending_lane_count -= len(job.plan)
+                batch.append(job)
+                lanes += len(job.plan)
+            self._in_flight = len(batch)
+            try:
+                verdicts = await loop.run_in_executor(
+                    None, self._verify_jobs, [j.plan for j in batch])
+                for job, v in zip(batch, verdicts):
+                    if not job.future.done():
+                        job.future.set_result(v)
+            except asyncio.CancelledError:
+                for job in batch:
+                    if not job.future.done():
+                        job.future.cancel()
+                raise
+            except Exception as e:  # defensive: a verdict must land
+                logger.exception("light verify batch died")
+                for job in batch:
+                    if not job.future.done():
+                        job.future.set_exception(e)
+            finally:
+                self._in_flight = 0
+
+    # -- the coalesced verify launch (executor thread) -----------------
+
+    def _verify_jobs(self, plans: list[CommitVerifyPlan]
+                     ) -> list[np.ndarray]:
+        """Flatten every plan's triples into one launch, scatter the
+        per-lane verdicts back per plan."""
+        triples: list[tuple] = []
+        spans: list[tuple[int, int]] = []
+        for plan in plans:
+            t = plan.triples()
+            spans.append((len(triples), len(t)))
+            triples.extend(t)
+        verdicts = self._verify_triples(triples)
+        return [verdicts[off:off + n] for off, n in spans]
+
+    def _verify_triples(self, triples: list[tuple]) -> np.ndarray:
+        # Same dispatch stance as the admission plane: one wide
+        # general-kernel launch with a known-answer sentinel lane,
+        # breaker-aware, host fallback — and the shared crypto/tpu
+        # device-health counters move so dashboards see light-plane
+        # launches next to consensus ones. Cross-plan batches mix
+        # validator sets, so the general kernel (per-lane keys) is
+        # the right tool, not any one set's expanded tables.
+        from ..crypto import batch as cbatch
+        from ..libs import failpoints
+        from ..libs.metrics import (crypto_metrics, light_metrics,
+                                    tpu_metrics)
+
+        met = light_metrics()
+        n = len(triples)
+        met.batch_lanes.observe(n)
+        t0 = time.perf_counter()
+        try:
+            try:
+                failpoints.hit("light.verify")
+            except failpoints.FailpointError:
+                # injected launch failure: degrade to the host oracle,
+                # exactly like a raising device launch
+                met.verify_launches.inc(backend="host")
+                crypto_metrics().batch_lanes.inc(n, backend="host")
+                return self._host_verify(triples)
+            ed = [i for i, (pk, _, _) in enumerate(triples)
+                  if pk.type_name == "ed25519"]
+            ed_set = set(ed)
+            out = np.zeros(n, bool)
+            # non-ed25519 lanes (sr25519/secp256k1 validators) verify
+            # on host per key — rare in practice, never worth a
+            # second kernel here
+            for i in range(n):
+                if i not in ed_set:
+                    pk, m, s = triples[i]
+                    try:
+                        out[i] = pk.verify_signature(m, s)
+                    except Exception:
+                        out[i] = False
+            if not ed:
+                met.verify_launches.inc(backend="host")
+                return out
+            want_dev = len(ed) >= self.device_threshold
+            use_dev = want_dev and cbatch.breaker("ed25519").acquire()
+            if use_dev:
+                try:
+                    from ..crypto.tpu import verify as tpu_verify
+
+                    failpoints.hit("device.verify")
+                    # device_launches counts ATTEMPTS (the core
+                    # BatchVerifier convention — a raising launch
+                    # still burned a launch slot)
+                    crypto_metrics().device_launches.inc()
+                    # one known-answer sentinel lane rides every
+                    # device batch (the breaker probe's triple): a
+                    # NaN-ing kernel fails the sentinel, so wrong
+                    # verdicts are detected POSITIVELY and the batch
+                    # re-verifies on host instead of failing client
+                    # requests on headers that are actually valid
+                    spub, smsg, ssig = cbatch._ed_probe_triple()
+                    dv = np.asarray(tpu_verify.verify_batch(
+                        [triples[i][0].bytes() for i in ed] + [spub],
+                        [triples[i][1] for i in ed] + [smsg],
+                        [triples[i][2] for i in ed] + [ssig]), bool)
+                    # the launch LANDED: only now does it count as a
+                    # device verify — a raising launch falls through
+                    # to the host path as ONE host launch, never
+                    # device+host for the same flush
+                    met.verify_launches.inc(backend="device")
+                    crypto_metrics().batch_lanes.inc(len(ed),
+                                                     backend="tpu")
+                    if dv[-1]:
+                        out[np.asarray(ed)] = dv[:-1]
+                        return out
+                    cbatch.mark_device_failed("ed25519")
+                    logger.error(
+                        "light verify batch (%d lanes) failed its "
+                        "known-answer sentinel; breaker open %.1fs, "
+                        "re-verifying on host", len(ed),
+                        cbatch.breaker("ed25519").cooldown_remaining())
+                    met.verify_launches.inc(backend="host_recheck")
+                    tpu_metrics().host_fallbacks.inc()
+                    return self._host_verify(triples, into=out, only=ed)
+                except Exception:
+                    cbatch.mark_device_failed("ed25519")
+                    logger.exception(
+                        "light device batch failed (%d lanes); "
+                        "breaker open %.1fs, degrading to host",
+                        len(ed),
+                        cbatch.breaker("ed25519").cooldown_remaining())
+            if want_dev:
+                tpu_metrics().host_fallbacks.inc()
+            met.verify_launches.inc(backend="host")
+            crypto_metrics().batch_lanes.inc(len(ed), backend="host")
+            return self._host_verify(triples, into=out, only=ed)
+        finally:
+            met.verify_seconds.observe(time.perf_counter() - t0)
+
+    @staticmethod
+    def _host_verify(triples: list[tuple], into: np.ndarray | None = None,
+                     only: list[int] | None = None) -> np.ndarray:
+        out = np.zeros(len(triples), bool) if into is None else into
+        idxs = range(len(triples)) if only is None else only
+        for i in idxs:
+            pk, m, s = triples[i]
+            try:
+                out[i] = len(s) == 64 and pk.verify_signature(m, s)
+            except Exception:
+                out[i] = False
+        return out
+
+
+class ServingPlane:
+    """The shared verification plane N proxy workers run requests
+    through. One plane owns one light Client (and its trusted store);
+    requests enter via get_verified()."""
+
+    def __init__(self, client, config=None, controller=None):
+        from ..config import LightConfig
+
+        cfg = config or LightConfig()
+        cfg.validate_basic()
+        self.client = client
+        self.config = cfg
+        self.cache = VerifiedHeaderCache(
+            cfg.cache_size, client.trust_options.period_ns)
+        self.collector = LightVerifyCollector(
+            batch_max=cfg.batch_max, flush_ms=cfg.flush_ms,
+            pending_max=cfg.pending_max, controller=controller)
+        self._inflight: dict[int, asyncio.Task] = {}
+        # running tallies for the /status `light` check (metric
+        # counters mirror these with labels)
+        self.requests = 0
+        self.coalesced = 0
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.sheds: dict[str, int] = {r: 0 for r in SHED_REASONS}
+        global _ACTIVE_PLANE
+        _ACTIVE_PLANE = self
+
+    def close(self) -> None:
+        self.collector.close()
+        for task in self._inflight.values():
+            task.cancel()
+        self._inflight.clear()
+        global _ACTIVE_PLANE
+        if _ACTIVE_PLANE is self:
+            _ACTIVE_PLANE = None
+
+    # -- the request entry point ---------------------------------------
+
+    async def get_verified(self, height: int = 0) -> LightBlock:
+        """Verified LightBlock at `height` (0 = the primary's latest).
+        Coalesces with any in-flight verification of the same height;
+        sheds (LightServingShedError) when the pending-verify backlog
+        is at its bound and this request would start a NEW
+        verification."""
+        from ..libs.metrics import light_metrics
+
+        met = light_metrics()
+        self.requests += 1
+        now_ns = self.client.now_fn()
+        if height:
+            lb = self.cache.get(height, now_ns)
+            if lb is not None:
+                self.cache_hits += 1
+                met.cache_hits.inc()
+                return lb
+            self.cache_misses += 1
+            met.cache_misses.inc()
+            # trusted-store probe BEFORE the admission gate: a height
+            # already verified and still inside its period is a READ,
+            # not new verification work — it serves even with the
+            # plane fully saturated (LRU refilled in passing), and
+            # without spawning a singleflight task
+            stored = self.client.store.get(height)
+            if stored is not None and stored.time() + \
+                    self.client.trust_options.period_ns > now_ns:
+                self.cache.put(stored, now_ns)
+                return stored
+        task = self._inflight.get(height)
+        if task is not None and not task.done():
+            # join the in-flight verification: no new device work, no
+            # queue growth — the whole point of the singleflight map
+            self.coalesced += 1
+            met.requests_coalesced.inc()
+            return await self._await_counted(task)
+        if self.collector.saturated():
+            # shed at ADMISSION: a flood of distinct heights must die
+            # here with a cheap 429, not deep inside a bisection.
+            # This gate covers backwards walks too — they never enter
+            # the pending-verify queue, but each one is new work
+            # (primary fetches per uncached interim), and a scrape-
+            # the-history flood of distinct cold heights must not
+            # amplify into unbounded concurrent walks while the
+            # plane is already saturated. Store-resident heights
+            # were served above, before the gate.
+            self._count_shed(SHED_QUEUE_FULL)
+            raise LightServingShedError(self.collector.depth(),
+                                        self.collector.pending_max)
+        task = asyncio.get_running_loop().create_task(
+            self._verify_height(height, now_ns),
+            name=f"light-verify-h{height}")
+        self._inflight[height] = task
+
+        def _done(t, h=height):
+            if self._inflight.get(h) is t:
+                del self._inflight[h]
+            # every waiter may have been cancelled (client timeouts
+            # are routine on a public proxy) while the shielded task
+            # ran on — retrieve the exception so asyncio doesn't log
+            # "Task exception was never retrieved" for an error that
+            # simply had no one left to deliver to
+            if not t.cancelled():
+                t.exception()
+
+        task.add_done_callback(_done)
+        return await self._await_counted(task)
+
+    async def _await_counted(self, task: asyncio.Task) -> LightBlock:
+        """Await the shared verification (shield: a cancelled waiter
+        must not cancel the task other coalesced waiters are parked
+        on) and count a mid-verification shed PER AFFECTED REQUEST —
+        every waiter surfaces a 429, so every waiter moves the shed
+        counters, keeping 429s == light_shed_total == /status tally
+        even when coalesced joiners ride a verification that sheds."""
+        try:
+            return await asyncio.shield(task)
+        except LightServingShedError:
+            self._count_shed(SHED_QUEUE_FULL)
+            raise
+
+    def _count_shed(self, reason: str) -> None:
+        """ONE shed request: /status tally + metric + the controller
+        tracking the pending-verify queue (the collector's, which may
+        be an injected test controller — never unconditionally the
+        process-global one)."""
+        from ..libs.metrics import light_metrics
+
+        self.sheds[reason] += 1
+        light_metrics().shed.inc(reason=reason)
+        self.collector._controller.shed(PENDING_VERIFY_QUEUE)
+
+    # -- the singleflight body -----------------------------------------
+
+    async def _verify_height(self, height: int,
+                             now_ns: int) -> LightBlock:
+        cl = self.client
+        if not cl._initialized:
+            await cl.initialize()
+        period = cl.trust_options.period_ns
+        if height:
+            stored = cl.store.get(height)
+            if stored is not None:
+                if stored.time() + period > now_ns:
+                    self.cache.put(stored, now_ns)
+                    return stored
+                # outside its trusting period: the old verification
+                # alone no longer makes it servable (the serial
+                # client returns stored blocks unconditionally — the
+                # plane serves UNTRUSTED public clients and enforces
+                # the cache's documented invariant on the store path
+                # too). Below the trusted head the backwards walk
+                # re-proves it by hash linkage from an IN-period
+                # anchor; at the head there is nothing to anchor on.
+                latest = cl.store.latest()
+                if latest is None or height >= latest.height():
+                    raise OutsideTrustingPeriodError(
+                        f"stored header {height} outside trusting "
+                        "period")
+                return await cl._verify_backwards(height, now_ns)
+            latest = cl.store.latest()
+            if latest is not None and height < latest.height():
+                # hash-chain walk down — no commit signatures to
+                # batch; the client's walk (with its linkage cache)
+                # is already the right tool
+                lb = await cl._verify_backwards(height, now_ns)
+                self.cache.put(lb, now_ns)
+                return lb
+            target = await cl._from_primary(height)
+        else:
+            target = await cl._from_primary(0)
+            latest = cl.store.latest()
+            if latest is not None and \
+                    target.height() <= latest.height():
+                if latest.time() + period <= now_ns:
+                    raise OutsideTrustingPeriodError(
+                        f"trusted head {latest.height()} outside "
+                        "trusting period")
+                self.cache.put(latest, now_ns)
+                return latest
+        # verify from the head captured BEFORE the fetch (the serial
+        # client's order): a concurrent task may have advanced
+        # store.latest() past `height` while _from_primary awaited,
+        # and a re-read here would make _common_checks refuse a
+        # perfectly servable height ("target not above trusted")
+        trusted = latest
+        assert trusted is not None
+        try:
+            await self._verify_skipping(trusted, target, now_ns)
+            await cl._detect_divergence(target, now_ns)
+        except DivergenceError:
+            # a PROVEN fork purged the trusted store above the common
+            # height — the LRU may still hold the attacker's chain;
+            # drop everything rather than risk serving it
+            self.cache.clear()
+            raise
+        # a mid-verification LightServingShedError propagates
+        # UNcounted from here: _await_counted counts it once per
+        # affected waiter (the collector raises uncounted too — one
+        # request may park two plans and both may shed)
+        self.cache.put(target, now_ns)
+        return target
+
+    # -- batched skipping verification ---------------------------------
+
+    async def _verify_skipping(self, trusted: LightBlock,
+                               target: LightBlock,
+                               now_ns: int) -> None:
+        """Client._verify_skipping with the commit checks routed
+        through the coalescing collector: same pivots, same error
+        taxonomy, same store writes."""
+        cl = self.client
+        pending: list[LightBlock] = [target]
+        seen: set[int] = {target.height()}
+        steps = 0
+        while pending:
+            steps += 1
+            if steps > 200:  # 2^200 heights — unreachable honestly
+                raise LightClientError("bisection did not converge")
+            block = pending[-1]
+            try:
+                await self._verify_one(trusted, block, now_ns)
+            except NewValSetCantBeTrustedError:
+                pivot_h = (trusted.height() + block.height()) // 2
+                if pivot_h in (trusted.height(), block.height()) or \
+                        pivot_h in seen:
+                    raise  # can't split further: genuine failure
+                pivot = await cl._from_primary(pivot_h)
+                seen.add(pivot_h)
+                pending.append(pivot)
+                continue
+            cl.store.save(block)
+            self.cache.put(block, now_ns)
+            trusted = block
+            pending.pop()
+
+    async def _verify_one(self, trusted: LightBlock,
+                          untrusted: LightBlock, now_ns: int) -> None:
+        """verifier.verify with the signature work pooled: the
+        non-crypto checks run inline, the commit check(s) become
+        CommitVerifyPlans awaited through the collector — the two
+        checks of a non-adjacent step verify CONCURRENTLY, so they
+        coalesce with each other and with every other in-flight
+        request's checks into the same wide launches."""
+        from .verifier import _common_checks
+
+        cl = self.client
+        chain_id = cl.chain_id
+        period = cl.trust_options.period_ns
+        sh = untrusted.signed_header
+        if untrusted.height() == trusted.height() + 1:
+            _common_checks(chain_id, trusted, untrusted, period, now_ns)
+            if sh.header.validators_hash != \
+                    trusted.signed_header.header.next_validators_hash:
+                raise VerificationFailedError(
+                    "new validators_hash != trusted next_validators_hash")
+            try:
+                plan = untrusted.validator_set.plan_commit_light(
+                    chain_id, sh.commit.block_id, sh.header.height,
+                    sh.commit)
+            except VerificationError as e:
+                raise VerificationFailedError(
+                    f"invalid commit: {e}") from e
+            try:
+                await self.collector.check(plan)
+            except VerificationError as e:
+                raise VerificationFailedError(
+                    f"invalid commit: {e}") from e
+            return
+        _common_checks(chain_id, trusted, untrusted, period, now_ns)
+        try:
+            plan_trusting = trusted.validator_set.plan_commit_trusting(
+                chain_id, sh.commit, cl.trust_level.numerator,
+                cl.trust_level.denominator)
+        except VerificationError as e:
+            raise NewValSetCantBeTrustedError(str(e)) from e
+        try:
+            plan_light = untrusted.validator_set.plan_commit_light(
+                chain_id, sh.commit.block_id, sh.header.height,
+                sh.commit)
+        except VerificationError as e:
+            # own-commit cannot even reach 2/3 — but the reference
+            # order gives the TRUSTING check its verdict first, and a
+            # failed overlap drives bisection, not rejection
+            try:
+                await self.collector.check(plan_trusting)
+            except VerificationError as e2:
+                raise NewValSetCantBeTrustedError(str(e2)) from e2
+            raise VerificationFailedError(f"invalid commit: {e}") from e
+        # both-or-neither admission for the gathered pair: if only
+        # ONE slot remains, parking the trusting check and shedding
+        # its twin would delay the 429 until the admitted (possibly
+        # stalled) launch completes and throw its verdict away —
+        # shed promptly instead (the per-check gate in check() stays
+        # the hard bound)
+        coll = self.collector
+        if coll.depth() + 2 > coll.pending_max:
+            raise LightServingShedError(coll.depth(), coll.pending_max)
+        res_t, res_l = await asyncio.gather(
+            self.collector.check(plan_trusting),
+            self.collector.check(plan_light),
+            return_exceptions=True)
+        # error taxonomy parity with verifier.verify_non_adjacent: a
+        # failed TRUSTING check (insufficient overlap OR bad overlap
+        # signature) drives bisection; a failed own-commit check is a
+        # definitive rejection; anything else (shed, cancellation)
+        # propagates untouched
+        if isinstance(res_t, VerificationError):
+            raise NewValSetCantBeTrustedError(str(res_t)) from res_t
+        if isinstance(res_t, BaseException):
+            raise res_t
+        if isinstance(res_l, VerificationError):
+            raise VerificationFailedError(
+                f"invalid commit: {res_l}") from res_l
+        if isinstance(res_l, BaseException):
+            raise res_l
+
+    # -- /status -------------------------------------------------------
+
+    def status_check(self) -> dict:
+        """The GET /status `light` check body: backlog fill, request/
+        coalesce/cache tallies, shed breakdown, verify-backend split.
+        Shedding is designed behavior — only a saturated pending-
+        verify backlog degrades the check."""
+        from ..crypto import batch as cbatch
+        from ..libs.metrics import light_metrics
+
+        met = light_metrics()
+        depth = self.collector.depth()
+        cap = self.collector.pending_max
+        out: dict = {
+            "requests": self.requests,
+            "coalesced": self.coalesced,
+            "cache": {"entries": len(self.cache),
+                      "hits": self.cache_hits,
+                      "misses": self.cache_misses},
+            "queue_depth": depth,
+            "queue_capacity": cap,
+            "shed": {r: n for r, n in self.sheds.items() if n},
+            "trusted_height": self.client.store.latest_height(),
+            "verify_launches": {
+                b: int(met.verify_launches.value(backend=b))
+                for b in ("device", "host", "host_recheck")
+                if met.verify_launches.value(backend=b)},
+        }
+        fill = depth / cap if cap else 0.0
+        if fill >= 0.8:
+            out["status"] = "degraded"
+            out["detail"] = (f"pending-verify backlog at {fill:.0%}; "
+                             "shedding newest requests soon")
+        else:
+            out["status"] = "ok"
+            if not cbatch.device_available("ed25519"):
+                out["detail"] = ("ed25519 breaker open: light plane "
+                                 "verifying on host")
+        return out
+
+
+class ServingPool:
+    """N LightProxy workers sharing ONE plane (one client, one trusted
+    store, one verify collector, one cache) — the horizontally
+    scalable serving face: more workers add RPC accept/parse
+    capacity, while every verification still coalesces in the shared
+    plane."""
+
+    def __init__(self, client, workers: int | None = None, config=None,
+                 forward_clients=None, proof_runtime=None):
+        from ..config import LightConfig
+        from .proxy import LightProxy
+
+        cfg = config or LightConfig()
+        n = cfg.workers if workers is None else workers
+        if n < 1:
+            raise ValueError("serving pool needs at least one worker")
+        self.plane = ServingPlane(client, cfg)
+        fwds = forward_clients or [None] * n
+        if len(fwds) != n:
+            raise ValueError(
+                f"{len(fwds)} forward clients for {n} workers")
+        self.proxies = [
+            LightProxy(client, forward_client=fwds[i],
+                       proof_runtime=proof_runtime, plane=self.plane)
+            for i in range(n)
+        ]
+        self.ports: list[int] = []
+
+    async def listen(self, host: str,
+                     ports: list[int] | None = None) -> list[int]:
+        ports = ports or [0] * len(self.proxies)
+        if len(ports) != len(self.proxies):
+            raise ValueError(
+                f"{len(ports)} ports for {len(self.proxies)} workers")
+        self.ports = [await proxy.listen(host, port)
+                      for proxy, port in zip(self.proxies, ports)]
+        logger.info("light serving pool: %d workers on %s:%s",
+                    len(self.proxies), host, self.ports)
+        return self.ports
+
+    def close(self) -> None:
+        for proxy in self.proxies:
+            proxy.close()
+        self.plane.close()

@@ -21,6 +21,14 @@ def _key(height: int) -> bytes:
 class LightStore:
     def __init__(self, db):
         self.db = db
+        # Highest saved height, maintained incrementally after the
+        # first scan. latest_height() used to walk the WHOLE prefix on
+        # every call — and the light client calls it (via latest()) on
+        # every single verify request, so a proxy serving a long chain
+        # paid an O(stored-heights) scan per request. None = unknown
+        # (not yet scanned, or invalidated by a delete/prune that may
+        # have removed the maximum).
+        self._latest: int | None = None
 
     def save(self, lb: LightBlock) -> None:
         payload = json.dumps({
@@ -29,6 +37,8 @@ class LightStore:
             "validators": _valset_to_json(lb.validator_set),
         }).encode()
         self.db.set(_key(lb.height()), payload)
+        if self._latest is not None:
+            self._latest = max(self._latest, lb.height())
 
     def get(self, height: int) -> LightBlock | None:
         raw = self.db.get(_key(height))
@@ -46,11 +56,13 @@ class LightStore:
         return self.get(latest_h) if latest_h else None
 
     def latest_height(self) -> int:
-        best = 0
-        for k, _ in self.db.iterate_prefix(_PREFIX):
-            h = int.from_bytes(k[len(_PREFIX):], "big")
-            best = max(best, h)
-        return best
+        if self._latest is None:
+            best = 0
+            for k, _ in self.db.iterate_prefix(_PREFIX):
+                h = int.from_bytes(k[len(_PREFIX):], "big")
+                best = max(best, h)
+            self._latest = best
+        return self._latest
 
     def lowest_height(self) -> int:
         for k, _ in self.db.iterate_prefix(_PREFIX):
@@ -63,8 +75,15 @@ class LightStore:
 
     def delete(self, height: int) -> None:
         self.db.delete(_key(height))
+        if self._latest is not None and height >= self._latest:
+            # the cached maximum may be gone; rescan on next read
+            self._latest = None
 
     def prune(self, keep: int) -> None:
         hs = self.heights()
         for h in hs[:-keep] if keep else hs:
             self.db.delete(_key(h))
+        # pruning keeps the TOP `keep` heights, so the maximum
+        # survives when keep > 0 — but a full prune empties the store
+        if not keep:
+            self._latest = None

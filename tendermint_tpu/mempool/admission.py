@@ -234,9 +234,13 @@ class AdmissionCollector:
                     from ..crypto.tpu import verify as tpu_verify
 
                     failpoints.hit("device.verify")
-                    met.launches.inc(backend="device")
+                    # device_launches counts ATTEMPTS (the core
+                    # BatchVerifier convention); the admission
+                    # namespace launch counter and the tpu lane
+                    # count land only after the launch returns, so a
+                    # raising launch falls through as ONE host
+                    # launch, never device+host for the same flush
                     crypto_metrics().device_launches.inc()
-                    crypto_metrics().batch_lanes.inc(n, backend="tpu")
                     # one extra known-answer sentinel lane rides every
                     # batch (the breaker probe's triple): a NaN-ing
                     # kernel fails the sentinel, so a suspect verdict
@@ -250,6 +254,8 @@ class AdmissionCollector:
                         [tx_envelope.sign_bytes(e.payload)
                          for e in envs] + [smsg],
                         [e.signature for e in envs] + [ssig]), bool)
+                    met.launches.inc(backend="device")
+                    crypto_metrics().batch_lanes.inc(n, backend="tpu")
                     if out[-1]:
                         return out[:n]
                     # sentinel mismatch: wrong-verdict device (the

@@ -31,6 +31,61 @@ class VerificationError(Exception):
     pass
 
 
+class CommitVerifyPlan:
+    """One commit-check decomposed into its signature lanes BEFORE any
+    cryptography runs: the selection loops of verify_commit_light /
+    verify_commit_light_trusting (power tally, address matching, the
+    insufficient-power rejections) produce a plan, and the signature
+    work is a separate step. The split lets the light serving plane
+    (light/serving.py) coalesce the lanes of MANY independent plans —
+    concurrent client requests, both checks of one skipping step —
+    into a single wide device launch, while the classic verify_commit*
+    methods just plan + execute inline."""
+
+    __slots__ = ("valset", "lanes", "slots", "sigs", "msgs")
+
+    def __init__(self, valset: "ValidatorSet", lanes: list[int],
+                 slots: list[int], sigs: list[bytes], msgs):
+        self.valset = valset
+        self.lanes = lanes    # indices into valset.validators (tables)
+        self.slots = slots    # commit signature slots (error reports)
+        self.sigs = sigs
+        self.msgs = msgs      # list[bytes] | StructuredSignBytes
+
+    def __len__(self) -> int:
+        return len(self.lanes)
+
+    def triples(self) -> list[tuple]:
+        """(pub_key, sign_bytes, signature) per lane, msgs
+        materialized — the form a cross-plan batch consumes (different
+        plans may come from different validator sets, so the shared
+        launch uses the general per-lane-key kernel, not this set's
+        expanded tables)."""
+        from .sign_batch import StructuredSignBytes
+
+        msgs = self.msgs.materialize() \
+            if isinstance(self.msgs, StructuredSignBytes) else self.msgs
+        return [(self.valset.validators[i].pub_key, m, s)
+                for i, m, s in zip(self.lanes, msgs, self.sigs)]
+
+    def raise_invalid(self, verdicts) -> None:
+        """Map per-lane verdicts back to commit slots; raise the same
+        VerificationError the inline verify_commit* paths produce."""
+        bad = [self.slots[i] for i in range(len(self.slots))
+               if not verdicts[i]]
+        if bad:
+            raise VerificationError(
+                f"invalid signature(s) at index(es) {bad}")
+
+    def execute(self) -> None:
+        """Verify this plan alone (the classic inline path): one
+        batch through the owning set's expanded tables / BatchVerifier."""
+        ok, verdicts = self.valset._batch_verify_lanes(
+            self.lanes, self.msgs, self.sigs)
+        if not ok:
+            self.raise_invalid(verdicts)
+
+
 class ValidatorSet:
     def __init__(self, validators: list[Validator]):
         self._total: int | None = None
@@ -384,10 +439,12 @@ class ValidatorSet:
                 f"insufficient voting power: {tallied} of {self.total_voting_power()}"
             )
 
-    def verify_commit_light(self, chain_id: str, block_id: BlockID,
-                            height: int, commit) -> None:
-        """Verify only the for-block signatures needed to pass 2/3
-        (reference: validator_set.go:720) — as one batch."""
+    def plan_commit_light(self, chain_id: str, block_id: BlockID,
+                          height: int, commit) -> CommitVerifyPlan:
+        """Selection half of verify_commit_light: basics + the
+        cheapest 2/3 of for-block power, NO signature work. Raises
+        VerificationError before planning any cryptography when the
+        power cannot reach the threshold."""
         self._check_commit_basics(block_id, height, commit)
         lanes: list[int] = []
         sigs: list[bytes] = []
@@ -407,16 +464,22 @@ class ValidatorSet:
                 f"insufficient voting power: {tallied} of {self.total_voting_power()}"
             )
         msgs = self._commit_msgs(chain_id, commit, lanes, lanes)
-        ok, verdicts = self._batch_verify_lanes(lanes, msgs, sigs)
-        if not ok:
-            bad = [lanes[i] for i in range(len(lanes)) if not verdicts[i]]
-            raise VerificationError(f"invalid signature(s) at index(es) {bad}")
+        return CommitVerifyPlan(self, lanes, lanes, sigs, msgs)
 
-    def verify_commit_light_trusting(self, chain_id: str, commit,
-                                     trust_num: int, trust_den: int) -> None:
-        """Trust-fraction variant for light-client skipping verification
-        (reference: validator_set.go:776). Validators are matched by
-        ADDRESS (the commit came from a possibly newer set)."""
+    def verify_commit_light(self, chain_id: str, block_id: BlockID,
+                            height: int, commit) -> None:
+        """Verify only the for-block signatures needed to pass 2/3
+        (reference: validator_set.go:720) — as one batch."""
+        self.plan_commit_light(chain_id, block_id, height,
+                               commit).execute()
+
+    def plan_commit_trusting(self, chain_id: str, commit,
+                             trust_num: int,
+                             trust_den: int) -> CommitVerifyPlan:
+        """Selection half of verify_commit_light_trusting: address
+        matching + the trust-level power tally, NO signature work.
+        Raises VerificationError (insufficient trusted power / double
+        vote) before planning any cryptography."""
         if trust_den <= 0 or trust_num <= 0 or trust_num > trust_den:
             raise ValueError("invalid trust level")
         lanes: list[int] = []  # OUR validator indices (for the tables)
@@ -445,10 +508,15 @@ class ValidatorSet:
                 f"insufficient trusted power: {tallied}"
             )
         msgs = self._commit_msgs(chain_id, commit, slots, lanes)
-        ok, verdicts = self._batch_verify_lanes(lanes, msgs, sigs)
-        if not ok:
-            bad = [slots[i] for i in range(len(slots)) if not verdicts[i]]
-            raise VerificationError(f"invalid signature(s) at index(es) {bad}")
+        return CommitVerifyPlan(self, lanes, slots, sigs, msgs)
+
+    def verify_commit_light_trusting(self, chain_id: str, commit,
+                                     trust_num: int, trust_den: int) -> None:
+        """Trust-fraction variant for light-client skipping verification
+        (reference: validator_set.go:776). Validators are matched by
+        ADDRESS (the commit came from a possibly newer set)."""
+        self.plan_commit_trusting(chain_id, commit, trust_num,
+                                  trust_den).execute()
 
     def _check_commit_basics(self, block_id: BlockID, height: int, commit) -> None:
         if commit is None:

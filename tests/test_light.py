@@ -305,6 +305,66 @@ def test_dead_primary_promotes_witness():
         run(cl3.verify_light_block_at_height(5))
 
 
+def test_store_latest_height_single_scan():
+    """LightStore.latest_height scans the prefix ONCE, then answers
+    O(1): saves update the cached maximum in place, deleting the
+    maximum (or a full prune) invalidates it, pruning to a keep-count
+    does not. The light client calls latest() on every verify request,
+    so this scan was per-request cost."""
+    chain = LightChain(8)
+    inner = MemDB()
+    scans = []
+
+    class CountingDB:
+        def set(self, k, v):
+            inner.set(k, v)
+
+        def get(self, k):
+            return inner.get(k)
+
+        def delete(self, k):
+            inner.delete(k)
+
+        def iterate_prefix(self, prefix):
+            scans.append(prefix)
+            return inner.iterate_prefix(prefix)
+
+    store = LightStore(CountingDB())
+    for h in (1, 3, 5):
+        store.save(chain.blocks[h])
+    assert store.latest_height() == 5
+    n_scans = len(scans)
+    assert n_scans == 1
+    # repeat reads and interleaved saves: zero further scans
+    assert store.latest_height() == 5
+    store.save(chain.blocks[7])
+    assert store.latest_height() == 7
+    store.save(chain.blocks[2])  # below the max: cache unchanged
+    assert store.latest_height() == 7
+    assert len(scans) == n_scans
+    # deleting a NON-max height keeps the cache...
+    store.delete(2)
+    assert store.latest_height() == 7
+    assert len(scans) == n_scans
+    # ...deleting the max invalidates it (one rescan, then O(1) again)
+    store.delete(7)
+    assert store.latest_height() == 5
+    assert len(scans) == n_scans + 1
+    assert store.latest_height() == 5
+    assert len(scans) == n_scans + 1
+    # prune keeping the top heights preserves the maximum: no rescan
+    # from latest_height (prune/heights themselves scan, by design)
+    store.prune(1)
+    assert store.heights() == [5]
+    base = len(scans)
+    assert store.latest_height() == 5
+    assert len(scans) == base
+    # full prune empties the store: the cache must not serve a ghost
+    store.prune(0)
+    assert store.latest_height() == 0
+    assert store.latest() is None
+
+
 def test_backwards_cache_and_trusted_anchor():
     """The backwards-walk linkage cache serves repeat walks without
     refetching, and anchor selection stays on TRUSTED blocks: a

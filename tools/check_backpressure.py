@@ -91,6 +91,32 @@ def collect_problems() -> list[str]:
                     f"{rel}: admission shed reason {lit or sym!r} not "
                     "in the closed mempool/admission.py SHED_REASONS "
                     "set")
+    # 1c. the light serving plane's shed surface, same contract: the
+    # metric family must exist and every `shed.inc(reason=...)` /
+    # `_count_shed(...)` call site must name a reason from the closed
+    # light/serving.py SHED_REASONS set (the per-reason counter is the
+    # evidence a request flood died at the plane, not the event loop)
+    from tendermint_tpu.light import serving as lsv
+
+    for name in ("light_shed_total", "light_batch_lanes",
+                 "light_verify_launches_total"):
+        if name not in declared:
+            problems.append(
+                f"{name}: missing from the libs/metrics.py catalog — "
+                "the light serving plane cannot prove its sheds "
+                "without it")
+    light_reason_re = re.compile(
+        r"""(?:\bshed\.inc\(\s*reason\s*=\s*|\b_count_shed\(\s*)"""
+        r"""(?:"([a-z_]+)"|(SHED_[A-Z_]+))""")
+    for rel, text in _product_sources():
+        for m in light_reason_re.finditer(text):
+            lit, sym = m.group(1), m.group(2)
+            reason = lit if lit is not None else \
+                getattr(lsv, sym, None)
+            if reason not in lsv.SHED_REASONS:
+                problems.append(
+                    f"{rel}: light shed reason {lit or sym!r} not in "
+                    "the closed light/serving.py SHED_REASONS set")
 
     # 2. catalog <-> call sites
     used: dict[str, list[str]] = {}

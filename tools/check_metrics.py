@@ -33,9 +33,9 @@ DOCS = os.path.join(REPO, "docs", "OBSERVABILITY.md")
 # `tpu` are this framework's additions; the rest mirror the reference
 # docs/nodes/metrics.md module list.
 NAMESPACES = {
-    "consensus", "crypto", "p2p", "mempool", "admission", "blockchain",
-    "statesync", "evidence", "state", "abci", "tpu", "tracing",
-    "failpoint", "rpc", "overload", "recovery",
+    "consensus", "crypto", "p2p", "mempool", "admission", "light",
+    "blockchain", "statesync", "evidence", "state", "abci", "tpu",
+    "tracing", "failpoint", "rpc", "overload", "recovery",
 }
 
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")

@@ -3,10 +3,21 @@ light/client_benchmark_test.go): sequential vs bisection verification
 over a synthetic chain, plus the underlying commit-verify cost.
 
     python tools/light_bench.py [--cpu] [--heights 64] [--vals 32]
+
+Concurrent-serving mode (`--clients N`) drives the light SERVING PLANE
+(light/serving.py) instead of the raw client: N concurrent clients fan
+out over `--span` distinct heights in two waves (cold, then warm), and
+the run emits a BENCH-style JSON line — requests/s, verify launches by
+backend, mean lanes per launch, cache hit ratio, coalesce count — so
+the serving plane enters the perf trajectory alongside the BENCH_r0*
+records:
+
+    python tools/light_bench.py --cpu --clients 64 --span 8
 """
 
 import asyncio
 import hashlib
+import json
 import os
 import sys
 import time
@@ -61,17 +72,120 @@ def build_chain(n_heights: int, n_vals: int):
     return chain_id, blocks
 
 
+def serving_bench(n_clients: int, n_heights: int, n_vals: int,
+                  span: int) -> dict:
+    """Drive the serving PLANE (not the raw client) with n_clients
+    concurrent requests over `span` distinct heights, two waves —
+    the in-process shape of a proxy fleet serving read-mostly
+    traffic. Returns (and prints) the BENCH-style record."""
+    from tendermint_tpu.config import LightConfig
+    from tendermint_tpu.libs.db import MemDB
+    from tendermint_tpu.libs.metrics import light_metrics
+    from tendermint_tpu.light import (
+        Client, LightStore, ServingPlane, TrustOptions,
+    )
+    from tendermint_tpu.light.provider import BlockNotFoundError, Provider
+
+    chain_id, blocks = build_chain(n_heights, n_vals)
+    span = max(1, min(span, n_heights - 1))
+    heights = list(range(n_heights - span + 1, n_heights + 1))
+    print(f"serving plane: {n_clients} clients x 2 waves over "
+          f"{span} distinct heights ({n_vals} validators)")
+
+    class P(Provider):
+        async def light_block(self, height):
+            if height == 0:
+                height = max(blocks)
+            lb = blocks.get(height)
+            if lb is None:
+                raise BlockNotFoundError(str(height))
+            return lb
+
+    now = blocks[1].time() + (n_heights + 100) * 10**9
+    period = 3600 * 10**9 * 24 * 365
+    cl = Client(chain_id,
+                TrustOptions(period_ns=period, height=1,
+                             hash=blocks[1].hash()),
+                P(), [], LightStore(MemDB()), now_fn=lambda: now)
+    plane = ServingPlane(cl, LightConfig())
+    met = light_metrics()
+
+    def launches():
+        return {b: int(met.verify_launches.value(backend=b))
+                for b in ("device", "host", "host_recheck")}
+
+    before = launches()
+    lanes0 = (met.batch_lanes.count, met.batch_lanes.sum)
+
+    async def wave():
+        await asyncio.gather(*(plane.get_verified(heights[i % span])
+                               for i in range(n_clients)))
+
+    async def run():
+        t0 = time.perf_counter()
+        await wave()       # cold: every height verifies (coalesced)
+        t_cold = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        await wave()       # warm: the cache answers
+        t_warm = time.perf_counter() - t0
+        return t_cold, t_warm
+
+    t_cold, t_warm = asyncio.run(run())
+    after = launches()
+    n_launches = {b: after[b] - before[b] for b in after
+                  if after[b] - before[b]}
+    total_launches = sum(n_launches.values())
+    d_count = met.batch_lanes.count - lanes0[0]
+    d_sum = met.batch_lanes.sum - lanes0[1]
+    requests = 2 * n_clients
+    hits = plane.cache_hits
+    record = {
+        "metric": "light_serving_requests_per_s",
+        "unit": "req/s",
+        "value": round(requests / (t_cold + t_warm), 1),
+        "clients": n_clients,
+        "distinct_heights": span,
+        "requests": requests,
+        "cold_wave_ms": round(t_cold * 1e3, 2),
+        "warm_wave_ms": round(t_warm * 1e3, 2),
+        "verify_launches": n_launches,
+        "lanes_per_launch": round(d_sum / d_count, 1) if d_count else 0,
+        "cache_hit_ratio": round(hits / requests, 3),
+        "requests_coalesced": plane.coalesced,
+        "shed": dict(plane.sheds),
+    }
+    # more launches than distinct heights is a coalescing regression
+    # ONLY when the launches were not lane-full: with huge valsets a
+    # single step's checks exceed the collector's batch_max and a
+    # perfectly coalescing plane legitimately splits across launches
+    mean_lanes = d_sum / d_count if d_count else 0
+    assert total_launches <= span or \
+        mean_lanes >= plane.collector.batch_max / 2, (
+            f"coalescing regressed: {total_launches} launches for "
+            f"{span} distinct heights at {mean_lanes:.0f} lanes/launch")
+    plane.close()
+    print(json.dumps(record), flush=True)
+    return record
+
+
 def main():
     if "--cpu" in sys.argv:
         from tendermint_tpu.libs.cpuforce import force_cpu_backend
 
         force_cpu_backend()
-    n_heights, n_vals = 64, 32
+    n_heights, n_vals, n_clients, span = 64, 32, 0, 8
     for i, a in enumerate(sys.argv):
         if a == "--heights":
             n_heights = int(sys.argv[i + 1])
         elif a == "--vals":
             n_vals = int(sys.argv[i + 1])
+        elif a == "--clients":
+            n_clients = int(sys.argv[i + 1])
+        elif a == "--span":
+            span = int(sys.argv[i + 1])
+    if n_clients > 0:
+        serving_bench(n_clients, n_heights, n_vals, span)
+        return
 
     from tendermint_tpu.libs.db import MemDB
     from tendermint_tpu.light import (
