@@ -20,7 +20,20 @@ from .msgs import (
     encode_bc_msg,
 )
 from .pool import BlockPool
-from .reactor import BLOCKCHAIN_CHANNEL, BlockchainReactor
+
+
+def __getattr__(name: str):
+    # The reactor is the only submodule that pulls in the p2p stack
+    # (and its optional `cryptography` dependency); loading it lazily
+    # keeps the pure core (pool, messages, the verify_ahead window
+    # pipeline) — and its unit tests/benches — importable without
+    # transport deps, same pattern as statesync/__init__.py.
+    if name in ("BlockchainReactor", "BLOCKCHAIN_CHANNEL"):
+        from . import reactor
+
+        return getattr(reactor, name)
+    raise AttributeError(name)
+
 
 __all__ = [
     "BlockPool", "BlockchainReactor", "BLOCKCHAIN_CHANNEL",

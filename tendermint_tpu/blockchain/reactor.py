@@ -20,10 +20,12 @@ import asyncio
 import logging
 import time
 
+# Module scope on purpose: the old per-synced-block function-local
+# import re-acquired the import lock inside the hottest loop in fast
+# sync (one acquisition per applied block).
+from ..libs.metrics import blockchain_metrics
 from ..p2p.conn.connection import ChannelDescriptor
 from ..p2p.switch import Reactor
-from ..types.block import BlockID
-from ..types.validator_set import VerificationError
 from .msgs import (
     BlockRequestMessage,
     BlockResponseMessage,
@@ -34,6 +36,7 @@ from .msgs import (
     encode_bc_msg,
 )
 from .pool import BlockPool
+from .verify_ahead import BATCH_WINDOW, WindowPipeline
 
 logger = logging.getLogger("blockchain")
 
@@ -44,97 +47,12 @@ STATUS_UPDATE_INTERVAL = 10.0     # reference statusUpdateTicker
 SWITCH_TO_CONSENSUS_INTERVAL = 1.0
 SYNC_TIMEOUT = 60.0               # reference syncTimeout: no progress →
                                   # give up waiting and run consensus
-BATCH_WINDOW = 16                 # blocks per device verification batch
-
-
-def _batch_verify_window(vals, chain_id: str, items):
-    """Verify the commits of several consecutive blocks — all signed by
-    the SAME validator set — in one device batch. `items` is a list of
-    (block_id, height, commit). Returns a list of per-block Exception
-    or None, mirroring VerifyCommitLight's accept/reject per block
-    (reference types/validator_set.go:720, batched across blocks).
-
-    Large all-ed25519 sets go through the expanded comb tables with
-    STRUCTURED sign bytes (one template group per block's commit,
-    types/sign_batch.py MergedSignBatch) — the same valset verifies
-    every block of the window AND every window of the catch-up, which
-    is exactly the workload the device-resident tables exist for.
-    Everything else (or any structural/device failure) falls back to
-    the general BatchVerifier with full bytes."""
-    spans: list = []
-    results: list = [None] * len(items)
-    lanes_all: list[int] = []
-    sigs_all: list[bytes] = []
-    per_commit: list[tuple] = []  # (commit, slots) per verifiable block
-    for i, (bid, height, commit) in enumerate(items):
-        start = len(lanes_all)
-        try:
-            vals._check_commit_basics(bid, height, commit)
-            need = 2 * vals.total_voting_power()
-            tallied = 0
-            slots: list[int] = []
-            for idx, cs in enumerate(commit.signatures):
-                if not cs.for_block():
-                    continue
-                val = vals.validators[idx]
-                lanes_all.append(idx)
-                slots.append(idx)
-                sigs_all.append(cs.signature)
-                tallied += val.voting_power
-                if 3 * tallied > need:
-                    break
-            if 3 * tallied <= need:
-                raise VerificationError(
-                    f"insufficient voting power at height {height}")
-            spans.append((i, start, len(lanes_all)))
-            per_commit.append((commit, slots))
-        except Exception as e:
-            results[i] = e
-            # roll back this block's lanes
-            del lanes_all[start:]
-            del sigs_all[start:]
-    if not lanes_all:
-        return results
-
-    verdicts = _window_lane_verdicts(
-        vals, chain_id, lanes_all, sigs_all, per_commit)
-    for i, start, end in spans:
-        if not bool(verdicts[start:end].all()):
-            results[i] = VerificationError(
-                f"invalid commit signature(s) for height "
-                f"{items[i][1]}")
-    return results
-
-
-def _window_lane_verdicts(vals, chain_id, lanes_all, sigs_all, per_commit):
-    """Per-lane verdicts for a window's collected lanes.
-
-    Builds the merged structured batch (one template group per
-    block's commit) when the expanded device path will consume it and
-    the commits' values fit the vectorized layout — hostile values
-    (e.g. a timestamp past int64) get full bytes instead, WITHOUT
-    tripping the device-failure cooldown, mirroring
-    ValidatorSet._commit_msgs. The verify ladder itself (structured →
-    bytes → host, device-failure degradation, logging) is owned by
-    ValidatorSet._batch_verify_lanes — one copy for every call site."""
-    from ..types.sign_batch import CommitSignBatch, MergedSignBatch
-
-    msgs = vals.structured_or_bytes(
-        lanes_all,
-        lambda: MergedSignBatch([
-            CommitSignBatch(chain_id, c, slots)
-            for c, slots in per_commit
-        ]),
-        lambda: [c.vote_sign_bytes(chain_id, s)
-                 for c, slots in per_commit for s in slots],
-    )
-    _, verdicts = vals._batch_verify_lanes(lanes_all, msgs, sigs_all)
-    return verdicts
 
 
 class BlockchainReactor(Reactor):
     def __init__(self, state, block_exec, block_store,
-                 fast_sync: bool, consensus_reactor=None):
+                 fast_sync: bool, consensus_reactor=None,
+                 verify_ahead: bool = True):
         super().__init__("blockchain")
         self.state = state
         self.block_exec = block_exec
@@ -149,6 +67,14 @@ class BlockchainReactor(Reactor):
         if not fast_sync:
             self.synced.set()
         self.blocks_synced = 0
+        # Overlapped execution (verify_ahead.py WindowPipeline): while
+        # window W's blocks execute through apply_block, window W+1's
+        # commits — already buffered, their verdicts fully determined
+        # by the fetched blocks — verify concurrently in an executor
+        # thread. Pure pipelining: verdicts are identical either way,
+        # and the save_block -> apply_block persistence order is
+        # untouched (tools/crash_sweep.py is the acceptance gate).
+        self.pipeline = WindowPipeline(enabled=verify_ahead)
 
     def get_channels(self) -> list[ChannelDescriptor]:
         return [ChannelDescriptor(id=BLOCKCHAIN_CHANNEL, priority=10,
@@ -170,6 +96,7 @@ class BlockchainReactor(Reactor):
         self.fast_sync = True
         self.synced.clear()
         self.pool = BlockPool(state.last_block_height + 1)
+        self.pipeline.reset()
         if self._task is not None and self._task.done():
             self._task = None
         await self.start()
@@ -208,8 +135,6 @@ class BlockchainReactor(Reactor):
         elif isinstance(msg, NoBlockResponseMessage):
             self.pool.no_block(peer.id, msg.height)
         elif isinstance(msg, BlockResponseMessage):
-            from ..libs.metrics import blockchain_metrics
-
             blockchain_metrics().block_bytes_received.inc(len(msgb))
             self.pool.add_block(peer.id, msg.block, len(msgb))
         else:
@@ -218,8 +143,6 @@ class BlockchainReactor(Reactor):
     # -- sync driver --
 
     async def _pool_routine(self) -> None:
-        from ..libs.metrics import blockchain_metrics
-
         bmet = blockchain_metrics()
         last_status = 0.0
         last_switch_check = 0.0
@@ -287,19 +210,19 @@ class BlockchainReactor(Reactor):
         """Verify+apply a window of contiguous fetched blocks. Block i
         is verified with block i+1's LastCommit, so with W+1 buffered
         blocks, W are verifiable — in one signature batch when the
-        validator set is stable (the overwhelmingly common case)."""
+        validator set is stable (the overwhelmingly common case).
+        While this window's blocks execute, the NEXT window's batch
+        verifies concurrently (verify-ahead), so steady-state catch-up
+        pays max(verify, apply) per window instead of their sum."""
         blocks = self.pool.peek_blocks(BATCH_WINDOW + 1)
         if len(blocks) < 2:
             return False
         vals = self.state.validators
         chain_id = self.state.chain_id
-        items = []
-        for i in range(len(blocks) - 1):
-            first, second = blocks[i], blocks[i + 1]
-            parts = first.make_part_set()
-            bid = BlockID(first.hash(), parts.header())
-            items.append((bid, first.header.height, second.last_commit))
-        results = _batch_verify_window(vals, chain_id, items)
+        items, parts_list, results = await self.pipeline.verdicts(
+            vals, chain_id, blocks)
+        self.pipeline.start_ahead(vals, chain_id,
+                                  self.pool.peek_blocks, len(blocks))
 
         applied = 0
         now = time.monotonic()
@@ -332,19 +255,22 @@ class BlockchainReactor(Reactor):
                 break
             first = blocks[i]
             bid = items[i][0]
-            parts = first.make_part_set()
+            # the part set built (off-loop) by the verify job — never
+            # re-serialize a full block on the event loop
+            parts = parts_list[i]
             self.pool.pop_request(now)
             self.block_store.save_block(first, parts, blocks[i + 1].last_commit)
             self.state, _ = await self.block_exec.apply_block(
                 self.state, bid, first)
             self.blocks_synced += 1
             applied += 1
-            from ..libs.metrics import blockchain_metrics
-
             blockchain_metrics().blocks_synced.inc()
             if self.state.validators.hash() != assumed_vals_hash:
                 # validator set changed mid-window: the remaining
                 # verdicts were computed against the wrong set — leave
                 # those blocks buffered for re-verification next pass
+                # (any in-flight verify-ahead window is stale too: its
+                # key carries the old valset hash, so the next pass
+                # discards it and re-verifies under the new set)
                 break
         return applied > 0

@@ -247,6 +247,12 @@ class StateSyncConfig:
 @dataclass
 class FastSyncConfig:
     version: str = "v0"
+    # Verify-ahead window pipelining (blockchain/verify_ahead.py
+    # WindowPipeline): window W+1's commit-signature batch verifies in
+    # an executor thread while window W's blocks execute. Verdicts and
+    # persistence order are identical either way — disable only to
+    # take executor-thread contention off a constrained host.
+    verify_ahead: bool = True
 
     def validate_basic(self) -> None:
         if self.version not in ("v0", "v2"):
@@ -316,6 +322,42 @@ class ConsensusConfig:
                 raise ValueError(f"{name} must be positive")
 
 
+@dataclass
+class SpeculationConfig:
+    """Verify-ahead pipeline (consensus/speculation.py +
+    crypto/tpu/resident.py; this framework's addition): commit
+    verification launched speculatively as precommits arrive, served
+    at commit time from a byte-exact template match — misses fall back
+    to the ordinary breaker-aware verify path, so these knobs tune
+    performance, never correctness."""
+
+    enabled: bool = True
+    # ResidentArena capacity in signature lanes (sentinel included).
+    # ~230 B/lane resident, so the default (12,288 = a 10,240-lane
+    # commit + headroom) costs ~2.8 MB of device memory — noise next
+    # to the expanded comb tables' 3.3 GB on a 16 GB chip. Valsets
+    # beyond the capacity speculate on the host path.
+    arena_lanes: int = 12288
+    # speculation entries kept beyond the current height (fast-sync /
+    # catch-up lookahead); entries below height-1 retire on commit
+    max_heights_ahead: int = 2
+    # micro-batch window: patches accumulate this long after the first
+    # pending arrival before a speculative launch (vote-scheduler
+    # cadence; 0 launches every drain immediately)
+    flush_ms: float = 2.0
+
+    def validate_basic(self) -> None:
+        if self.arena_lanes < 2:
+            raise ValueError(
+                "speculation.arena_lanes must be >= 2 (one sentinel "
+                "lane + at least one real lane)")
+        if self.max_heights_ahead < 1:
+            raise ValueError(
+                "speculation.max_heights_ahead must be positive")
+        if self.flush_ms < 0:
+            raise ValueError("negative speculation.flush_ms")
+
+
 def fast_consensus_config() -> ConsensusConfig:
     """Short timeouts for in-process tests (reference: the 10ms
     timeout-commit test config, config/config.go:867-875)."""
@@ -380,6 +422,8 @@ class Config:
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     fastsync: FastSyncConfig = field(default_factory=FastSyncConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    speculation: SpeculationConfig = field(
+        default_factory=SpeculationConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig
@@ -395,6 +439,7 @@ class Config:
         self.statesync.validate_basic()
         self.fastsync.validate_basic()
         self.consensus.validate_basic()
+        self.speculation.validate_basic()
         self.tx_index.validate_basic()
         self.chaos.validate_basic()
 
@@ -406,7 +451,8 @@ class Config:
         lines = []
         for section_name in ("base", "rpc", "p2p", "mempool", "light",
                              "statesync", "fastsync", "consensus",
-                             "tx_index", "instrumentation", "chaos"):
+                             "speculation", "tx_index",
+                             "instrumentation", "chaos"):
             section = getattr(self, section_name)
             lines.append(f"[{section_name}]")
             for f in dataclasses.fields(section):

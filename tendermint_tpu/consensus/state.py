@@ -60,9 +60,15 @@ class ConsensusState(Service):
     def __init__(self, config: ConsensusConfig, state: SmState,
                  block_exec: BlockExecutor, block_store: BlockStore,
                  mempool: Mempool | None = None, evpool=None,
-                 wal: WAL | None = None, event_bus: EventBus | None = None):
+                 wal: WAL | None = None, event_bus: EventBus | None = None,
+                 speculation=None):
         super().__init__(name="consensus.State")
         self.config = config
+        # Verify-ahead plane (consensus/speculation.py, wired by
+        # node._build from [speculation]): fed the proposal BlockID at
+        # _set_proposal and every current-height precommit at
+        # _add_vote; BlockExecutor serves commit verdicts from it.
+        self.speculation = speculation
         self.block_exec = block_exec
         self.block_store = block_store
         self.mempool = mempool or NopMempool()
@@ -145,6 +151,8 @@ class ConsensusState(Service):
         # a newer in-process node's same-name entries survive)
         self.peer_funnel.close()
         CONTROLLER.unregister("consensus.vote_buf", owner=self)
+        if self.speculation is not None:
+            self.speculation.close()
         if self.wal is not None:
             self.wal.close()
 
@@ -195,6 +203,8 @@ class ConsensusState(Service):
             valid_round=-1,
         )
         self.state = state
+        if self.speculation is not None:
+            self.speculation.retire_below(height)
         self._trace_new_height(height)
 
     def _trace_new_height(self, height: int) -> None:
@@ -814,6 +824,12 @@ class ConsensusState(Service):
                 proposal.block_id.part_set_header.total,
                 proposal.block_id.part_set_header.hash,
             )
+        if self.speculation is not None:
+            # the precommit sign-byte template for this height is now
+            # fully determined — start the verify-ahead pipeline
+            self.speculation.begin_height(
+                self.state.chain_id, rs.validators, rs.height,
+                proposal.round, proposal.block_id)
         self._broadcast("proposal", proposal)
 
     def _add_proposal_block_part(self, msg: m.BlockPartMessage) -> bool:
@@ -1184,6 +1200,11 @@ class ConsensusState(Service):
         added = rs.votes.add_vote(vote, peer_id, verify=verify)
         if not added:
             return False
+        if self.speculation is not None and \
+                vote.type == VoteType.PRECOMMIT:
+            # patch the verify-ahead lane (conflicting/nil votes are
+            # handled inside: they poison the lane, never serve)
+            self.speculation.observe_precommit(vote)
         self._publish_vote(vote)
         self._broadcast("has_vote", m.HasVoteMessage(
             vote.height, vote.round, int(vote.type), vote.validator_index

@@ -229,23 +229,20 @@ def _xkernel(wpi: int = WINDOWS_PER_ITER):
 
 
 @functools.cache
-def _skernel(wpi: int = WINDOWS_PER_ITER):
-    """Structured front-end: assemble the (N, width) message buffer ON
-    DEVICE from commit-wide templates plus a <=24-byte per-lane
-    timestamp patch (types/sign_batch.py layout:
-    outer_varint ‖ pre[group] ‖ ts_field ‖ suf[group], then SHA-512
-    padding). Per-lane transfer drops from ~190 B of sign bytes to the
-    patch + two ints; the templates ship once per launch."""
-    import jax
+def assemble_core():
+    """The structured message-assembly body as a traceable function:
+    (pre, pre_len, suf, suf_len, patch, split, patch_len, group,
+    width) -> (msg uint8 (N, width), nblocks (N,)). Builds each lane's
+    sign bytes ON DEVICE from commit-wide templates plus a <=24-byte
+    per-lane timestamp patch (types/sign_batch.py layout:
+    outer_varint ‖ pre[group] ‖ ts_field ‖ suf[group]) and applies the
+    SHA-512 padding tail. Shared by `_skernel` (expanded-table path)
+    and crypto/tpu/resident.py's arena kernel (general-kernel path
+    over device-resident buffers)."""
     import jax.numpy as jnp
 
-    core = _xcore(wpi)
-
-    @functools.partial(jax.jit, static_argnames=("width",))
-    def skernel(idx, akeys, sb, s_ok, key_ok, atab, btab,
-                pre, pre_len, suf, suf_len, patch, split, patch_len,
-                group, *, width):
-        n = idx.shape[0]
+    def assemble(pre, pre_len, suf, suf_len, patch, split, patch_len,
+                 group, width):
         j = jnp.arange(width, dtype=jnp.int32)[None, :]       # (1, W)
         p_len = pre_len[group][:, None]                       # (N, 1)
         s_len = suf_len[group][:, None]
@@ -279,8 +276,30 @@ def _skernel(wpi: int = WINDOWS_PER_ITER):
         lenbyte = jnp.where(k < 4, (bitlen >> (8 * jnp.clip(k, 0, 3)))
                             & 0xFF, 0)
         msg = jnp.where((k >= 0) & (k < 16), lenbyte, msg)
-        return core(idx, akeys, sb, msg.astype(jnp.uint8),
-                    nblocks[:, 0], s_ok, key_ok, atab, btab)
+        return msg.astype(jnp.uint8), nblocks[:, 0]
+
+    return assemble
+
+
+@functools.cache
+def _skernel(wpi: int = WINDOWS_PER_ITER):
+    """Structured front-end: assemble the (N, width) message buffer ON
+    DEVICE (assemble_core) then verify through the expanded-table body
+    (_xcore). Per-lane transfer drops from ~190 B of sign bytes to the
+    patch + two ints; the templates ship once per launch."""
+    import jax
+
+    core = _xcore(wpi)
+    assemble = assemble_core()
+
+    @functools.partial(jax.jit, static_argnames=("width",))
+    def skernel(idx, akeys, sb, s_ok, key_ok, atab, btab,
+                pre, pre_len, suf, suf_len, patch, split, patch_len,
+                group, *, width):
+        msg, nblocks = assemble(pre, pre_len, suf, suf_len, patch,
+                                split, patch_len, group, width)
+        return core(idx, akeys, sb, msg, nblocks, s_ok, key_ok, atab,
+                    btab)
 
     return skernel
 

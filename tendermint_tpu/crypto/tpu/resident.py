@@ -1,0 +1,291 @@
+"""ResidentArena: persistent device-resident verify buffers reused
+across launches via donated args.
+
+Round-4/5 silicon showed the general verify path re-ships ~100 B/lane
+(32 B pubkey + 64 B signature + per-lane sign-byte patches) on EVERY
+launch — at 10,240 lanes through the relay that transfer term, not the
+kernel, dominates end-to-end latency (docs/PERF_NOTES.md). In
+consensus the inputs barely change between launches: the pubkeys are
+the validator set (changes only on ABCI valset updates), and between
+two speculative launches of the same height only the lanes whose
+precommits just arrived differ.
+
+The arena therefore keeps every per-lane input array ON DEVICE:
+
+    ab (N, 32)  pubkey rows        — uploaded once per valset change
+    sb (N, 64)  signature rows     ┐
+    patch/split/patch_len/group    │ spliced per arrival via ONE
+    s_ok, active                   ┘ donated-jit scatter
+
+`splice()` ships only the delta rows (the sign-byte splice points +
+signatures of newly arrived votes, ~105 B/lane) and updates the
+resident arrays in place: `jax.jit(..., donate_argnums=...)` lets XLA
+alias the outputs onto the input buffers, so steady-state the arena
+never re-transfers — or re-allocates — the other lanes. `launch()`
+then verifies every active lane in one kernel combining the
+structured on-device message assembly (crypto/tpu/expanded.py
+assemble_core: template + per-lane timestamp patch) with the general
+verify body (crypto/tpu/verify.py general_core), carrying per-lane
+pubkey BYTES so no comb tables are required.
+
+Lane 0 is a permanent KNOWN-ANSWER SENTINEL (the ed25519 breaker
+probe's triple, PR-6 convention): a NaN-ing kernel fails the sentinel,
+so callers detect wrong-verdict devices positively instead of trusting
+garbage. Template group 0 is reserved for the sentinel's message.
+
+Transfer accounting feeds the `speculation` metrics namespace:
+`speculation_arena_bytes` (resident footprint) and
+`speculation_resident_reupload_bytes_total` (what splices + per-launch
+templates actually shipped) — the numbers `tools/crypto_bench.py
+--resident` A/Bs against fresh-transfer launches.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from . import verify as tv
+from .expanded import ExpandedKeys, assemble_core
+from ...types.sign_batch import PATCH_W
+
+# Template rows per arena (group 0 = sentinel); widths match the
+# structured-path guards in expanded.py (_prepare_structured): every
+# legal canonical vote fits.
+GROUPS = 8
+PRE_W = 128
+SUF_W = 64
+WIDTH = 192          # message-buffer width after the 64-byte R||A prefix
+_MIN_DELTA = 8       # splice delta rows pad to powers of two from here
+
+
+@functools.cache
+def _splice_fn():
+    """Donated scatter: every resident array in, updated array out —
+    XLA aliases outputs onto the donated inputs, so a steady-state
+    splice allocates nothing and uploads only the delta rows."""
+    import jax
+
+    def splice(sb, s_ok, patch, split, patch_len, group, active,
+               pos, d_sb, d_sok, d_patch, d_split, d_plen, d_group):
+        return (
+            sb.at[pos].set(d_sb),
+            s_ok.at[pos].set(d_sok),
+            patch.at[pos].set(d_patch),
+            split.at[pos].set(d_split),
+            patch_len.at[pos].set(d_plen),
+            group.at[pos].set(d_group),
+            active.at[pos].set(True),
+        )
+
+    return jax.jit(splice, donate_argnums=tuple(range(7)))
+
+
+@functools.cache
+def _clear_fn():
+    """Donated deactivate-all (sentinel lane 0 stays active)."""
+    import jax
+    import jax.numpy as jnp
+
+    def clear(active):
+        return jnp.zeros_like(active).at[0].set(True)
+
+    return jax.jit(clear, donate_argnums=(0,))
+
+
+@functools.cache
+def _arena_kernel(width: int):
+    """Structured assembly (expanded.assemble_core) in front of the
+    general verify body (verify.general_core) over per-lane resident
+    pubkey bytes; inactive lanes are masked to False on device."""
+    import jax
+
+    assemble = assemble_core()
+    core = tv.general_core()
+
+    @jax.jit
+    def kernel(ab, sb, s_ok, active, pre, pre_len, suf, suf_len,
+               patch, split, patch_len, group, btab):
+        msg, nblocks = assemble(pre, pre_len, suf, suf_len, patch,
+                                split, patch_len, group, width)
+        return core(ab, sb, msg, nblocks, s_ok, btab) & active
+
+    return kernel
+
+
+def _pad_rows(a: np.ndarray, rows: int) -> np.ndarray:
+    """Pad a delta array to `rows` by REPEATING row 0 — duplicate
+    scatter indices then write identical values, so padding can never
+    corrupt a real lane."""
+    if a.shape[0] == rows:
+        return a
+    reps = np.repeat(a[:1], rows - a.shape[0], axis=0)
+    return np.concatenate([a, reps], axis=0)
+
+
+class ResidentArena:
+    """Fixed-capacity device-resident lane buffers (slot 0 sentinel)."""
+
+    def __init__(self, lanes: int, width: int = WIDTH):
+        import jax.numpy as jnp
+
+        from .. import batch as cbatch
+
+        self.width = width
+        self.capacity = ExpandedKeys._bucket(max(lanes, 2))
+        n = self.capacity
+        spub, smsg, ssig = cbatch._ed_probe_triple()
+        assert len(smsg) <= PRE_W
+        ab = np.zeros((n, 32), np.uint8)
+        sb = np.zeros((n, 64), np.uint8)
+        ab[0] = np.frombuffer(spub, np.uint8)
+        sb[0] = np.frombuffer(ssig, np.uint8)
+        s_ok = tv.s_range_ok(sb).copy()
+        active = np.zeros(n, bool)
+        active[0] = True
+        self._ab = jnp.asarray(ab)
+        self._sb = jnp.asarray(sb)
+        self._s_ok = jnp.asarray(s_ok)
+        self._patch = jnp.zeros((n, PATCH_W), jnp.uint8)
+        self._split = jnp.zeros(n, jnp.int32)
+        self._patch_len = jnp.zeros(n, jnp.int32)
+        self._group = jnp.zeros(n, jnp.int32)
+        self._active = jnp.asarray(active)
+        # host-side template staging (small; shipped per launch)
+        self.pre = np.zeros((GROUPS, PRE_W), np.uint8)
+        self.pre_len = np.zeros(GROUPS, np.int32)
+        self.suf = np.zeros((GROUPS, SUF_W), np.uint8)
+        self.suf_len = np.zeros(GROUPS, np.int32)
+        self.pre[0, :len(smsg)] = np.frombuffer(smsg, np.uint8)
+        self.pre_len[0] = len(smsg)
+        self.reupload_bytes = 0
+        self._set_arena_gauge()
+
+    # -- sizes / metrics ----------------------------------------------
+
+    def arena_bytes(self) -> int:
+        # .nbytes off the array metadata — NEVER np.asarray here: on
+        # the CPU backend that returns a zero-copy VIEW pinning the
+        # buffer, and a pinned buffer defeats donation (XLA copies
+        # instead of aliasing) on every subsequent splice
+        return sum(int(a.nbytes) for a in (
+            self._ab, self._sb, self._s_ok, self._patch, self._split,
+            self._patch_len, self._group, self._active))
+
+    def _set_arena_gauge(self) -> None:
+        try:
+            from ...libs.metrics import speculation_metrics
+
+            speculation_metrics().arena_bytes.set(self.arena_bytes())
+        except Exception:  # pragma: no cover - metrics never fatal
+            pass
+
+    def _count_reupload(self, nbytes: int) -> None:
+        self.reupload_bytes += nbytes
+        try:
+            from ...libs.metrics import speculation_metrics
+
+            speculation_metrics().reupload_bytes.inc(nbytes)
+        except Exception:  # pragma: no cover - metrics never fatal
+            pass
+
+    # -- slow-path installs (valset / height changes) ------------------
+
+    def install_keys(self, pubkeys: list[bytes], start: int = 1) -> None:
+        """Upload pubkey rows for slots start..start+len-1 — once per
+        validator-set change, NOT per launch (that is the point)."""
+        import jax.numpy as jnp
+
+        assert start >= 1, "slot 0 is the sentinel"
+        assert start + len(pubkeys) <= self.capacity
+        assert all(len(p) == 32 for p in pubkeys)
+        ab = np.asarray(self._ab).copy()
+        ab[start:start + len(pubkeys)] = np.frombuffer(
+            b"".join(pubkeys), np.uint8).reshape(-1, 32)
+        self._ab = jnp.asarray(ab)
+
+    def set_template(self, group: int, pre: bytes, suf: bytes) -> None:
+        """Stage a (pre, suf) template row (group 0 is the sentinel's).
+        Templates are per height and tiny; they ship per launch."""
+        assert 1 <= group < GROUPS
+        assert len(pre) <= PRE_W and len(suf) <= SUF_W
+        self.pre[group] = 0
+        self.suf[group] = 0
+        self.pre[group, :len(pre)] = np.frombuffer(pre, np.uint8)
+        self.suf[group, :len(suf)] = np.frombuffer(suf, np.uint8)
+        self.pre_len[group] = len(pre)
+        self.suf_len[group] = len(suf)
+
+    def deactivate_all(self) -> None:
+        """New height: every lane but the sentinel goes inactive; the
+        buffers themselves stay resident for the next splices."""
+        self._active = _clear_fn()(self._active)
+
+    # -- the steady-state hot path ------------------------------------
+
+    def splice(self, slots, sig_rows: np.ndarray, patch: np.ndarray,
+               split: np.ndarray, patch_len: np.ndarray,
+               group: np.ndarray) -> None:
+        """Splice newly arrived lanes into the resident arrays: ships
+        ONLY these rows (donated scatter), ~105 B/lane."""
+        k = len(slots)
+        if k == 0:
+            return
+        pos = np.asarray(slots, np.int32)
+        assert pos.min() >= 1 and pos.max() < self.capacity, \
+            "slot 0 is the sentinel; slots must fit the arena"
+        sig_rows = np.asarray(sig_rows, np.uint8).reshape(k, 64)
+        d_sok = tv.s_range_ok(sig_rows)
+        bucket = _MIN_DELTA
+        while bucket < k:
+            bucket <<= 1
+        bucket = min(bucket, self.capacity)
+        if bucket < k:  # capacity-sized delta (full re-patch)
+            bucket = k
+        args = [_pad_rows(a, bucket) for a in (
+            pos, sig_rows, d_sok,
+            np.asarray(patch, np.uint8).reshape(k, PATCH_W),
+            np.asarray(split, np.int32).reshape(k),
+            np.asarray(patch_len, np.int32).reshape(k),
+            np.asarray(group, np.int32).reshape(k))]
+        self._count_reupload(sum(int(a.nbytes) for a in args))
+        (self._sb, self._s_ok, self._patch, self._split,
+         self._patch_len, self._group, self._active) = _splice_fn()(
+            self._sb, self._s_ok, self._patch, self._split,
+            self._patch_len, self._group, self._active,
+            *args)
+
+    def launch(self) -> np.ndarray:
+        """Verify every active lane (sentinel included): one kernel
+        launch over the resident buffers; only the templates (~1.5 KB)
+        travel host->device. Returns (capacity,) verdicts — inactive
+        lanes read False; callers check verdict[0] (the sentinel)
+        before trusting the rest."""
+        tv.count_compile("resident", (self.capacity, self.width))
+        self._count_reupload(
+            int(self.pre.nbytes + self.suf.nbytes
+                + self.pre_len.nbytes + self.suf_len.nbytes))
+        out = _arena_kernel(self.width)(
+            self._ab, self._sb, self._s_ok, self._active,
+            self.pre, self.pre_len, self.suf, self.suf_len,
+            self._patch, self._split, self._patch_len, self._group,
+            tv.b_comb_tables())
+        return np.asarray(out)
+
+    # -- introspection (tests pin donation with these) -----------------
+
+    def buffer_pointer(self, name: str = "sb"):
+        """unsafe_buffer_pointer of a resident array (None when the
+        backend doesn't expose it) — the donation round-trip test pins
+        that a splice REUSES the buffer where the backend supports
+        donation."""
+        arr = getattr(self, f"_{name}")
+        try:
+            return arr.unsafe_buffer_pointer()
+        except Exception:
+            try:
+                db = arr.addressable_data(0)
+                return db.unsafe_buffer_pointer()
+            except Exception:
+                return None

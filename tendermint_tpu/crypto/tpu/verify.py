@@ -169,8 +169,13 @@ def s_range_ok(sig_raw: np.ndarray) -> np.ndarray:
 
 
 @functools.cache
-def _kernel():
-    """Build the jitted device kernel lazily (imports jax on first use)."""
+def general_core():
+    """The general-kernel verify body as a traceable function of
+    (ab, sb, msg, nblocks, s_ok, btab) — per-lane pubkey BYTES, fully
+    assembled message buffers. Shared by the jitted `_kernel` here and
+    by crypto/tpu/resident.py's arena kernel (device-resident buffers
+    + on-device structured message assembly in front of this exact
+    body, so both paths verify bit-identically)."""
     import jax
     import jax.numpy as jnp
 
@@ -179,7 +184,6 @@ def _kernel():
     from . import sha512 as sh
     from .fieldsel import F as fe
 
-    @jax.jit
     def kernel(ab, sb, msg, nblocks, s_ok, btab):
         n = ab.shape[0]
         # --- SHA-512 of R || A || M, all lanes at once.
@@ -231,6 +235,20 @@ def _kernel():
         v = ed.add(v, neg_r)
         v = ed.double(ed.double(ed.double(v)))
         return ed.is_identity(v) & a_ok & r_ok & jnp.asarray(s_ok)
+
+    return kernel
+
+
+@functools.cache
+def _kernel():
+    """Build the jitted device kernel lazily (imports jax on first use)."""
+    import jax
+
+    core = general_core()
+
+    @jax.jit
+    def kernel(ab, sb, msg, nblocks, s_ok, btab):
+        return core(ab, sb, msg, nblocks, s_ok, btab)
 
     return kernel
 

@@ -45,9 +45,14 @@ from dataclasses import dataclass, field
 # verified header/commit requests with height overlap, and assert
 # coalescing (verify launches ≪ requests), response parity with the
 # primary, and 429 shed-newest under a light.verify-delay flood while
-# the backing net keeps committing
+# the backing net keeps committing;
+# spec_mismatch = arm `consensus.speculate` corrupt on the node (a
+# wrong-timestamp flood into the verify-ahead plane,
+# consensus/speculation.py) for `duration` seconds and assert
+# speculation hits drop to ZERO while the fallback path keeps every
+# commit verdict correct — the net must keep committing throughout
 OPS = ("kill", "pause", "disconnect", "disconnect_hard", "restart",
-       "chaos", "overload", "light_proxy")
+       "chaos", "overload", "light_proxy", "spec_mismatch")
 
 
 @dataclass
@@ -103,6 +108,11 @@ class Perturbation:
                 raise ValueError(
                     f"chaos action must be error|delay|corrupt, "
                     f"not {self.action!r}")
+        if self.op == "spec_mismatch":
+            if self.at_height < 2:
+                # the plane serves commits from height 1 up; arming
+                # before any commit exists would measure nothing
+                raise ValueError("spec_mismatch at_height must be >= 2")
         if self.op == "light_proxy":
             if self.at_height < 4:
                 # the plane needs a few committed heights to fan out

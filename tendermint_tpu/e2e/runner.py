@@ -315,6 +315,9 @@ class Runner:
         # one report dict per `light_proxy` perturbation — coalescing
         # ratio, parity with the primary, sheds under flood
         self.light_proxy_reports: list[dict] = []
+        # one report dict per `spec_mismatch` perturbation — hit/miss
+        # deltas under the wrong-timestamp flood + liveness through it
+        self.spec_mismatch_reports: list[dict] = []
 
     # -- stages --
 
@@ -355,7 +358,7 @@ class Runner:
                    for p in self.m.perturbations):
                 cfg.rpc.unsafe = True  # exposes unsafe_net_sever
             pprof_port = 0
-            if any(p.op in ("chaos", "overload")
+            if any(p.op in ("chaos", "overload", "spec_mismatch")
                    or (p.op == "kill" and p.failpoint)
                    for p in self.m.perturbations):
                 # chaos/overload perturbations drive the node's debug
@@ -680,6 +683,8 @@ class Runner:
             await asyncio.sleep(p.duration)
         elif p.op == "overload":
             await self._apply_overload(p, node)
+        elif p.op == "spec_mismatch":
+            await self._apply_spec_mismatch(p, node)
         elif p.op == "light_proxy":
             await self._apply_light_proxy(p, node)
         elif p.op == "chaos":
@@ -760,6 +765,87 @@ class Runner:
         assert recovered, (
             f"node{p.node} failed to recover past height {h0} after "
             f"crash at {p.failpoint}")
+
+    async def _apply_spec_mismatch(self, p: Perturbation,
+                                   node: NodeProc) -> None:
+        """Wrong-timestamp flood into the verify-ahead plane: arm
+        `consensus.speculate` corrupt on the node, so every lane
+        entering a speculative launch verifies (and later matches)
+        against a corrupted timestamp — at commit every speculated
+        lane mismatches. Asserts the degradation contract: hits drop
+        to ZERO for the window, the fallback path keeps serving
+        correct verdicts (misses climb, every commit still validates)
+        and the net keeps committing throughout."""
+        import json
+
+        res = await self._debug_post(node, "/debug/failpoint",
+                                     {"name": "consensus.speculate",
+                                      "action": "corrupt"})
+        assert "error" not in res, f"spec_mismatch arm failed: {res}"
+        h0 = await self.height_of(node)
+        try:
+            # two heights ON THE TARGET NODE under the armed corrupt:
+            # every speculation entry a subsequent serve can touch was
+            # launched (and corrupted) AFTER arming — pre-arm launches
+            # must not count as window hits. Gated on the node's OWN
+            # height (not the net max — a lagging target could still
+            # serve a pre-arm entry after a net-max settle).
+            own = 0
+
+            async def sample():
+                nonlocal own
+                try:
+                    own = max(own, await self.height_of(node))
+                except Exception:
+                    pass
+                return own
+
+            await wait_progress(sample, lambda h: h >= h0 + 2,
+                                timeout=60,
+                                what=f"node{p.node} past height "
+                                     f"{h0 + 2} under spec_mismatch")
+            def lane_misses(spec: dict) -> int:
+                # ONLY the per-lane fallback reasons prove a lane
+                # actually traversed the armed corrupt path — no_plan
+                # counts commits the plane never speculated (catch-up
+                # traffic) and must not satisfy the exercised guard
+                return sum(v for k, v in spec.get("misses", {}).items()
+                           if k != "no_plan")
+
+            st = json.loads(await self._debug_get(node, "/status"))
+            spec0 = st["checks"].get("speculation")
+            assert spec0 is not None, (
+                "no speculation check in /status — is [speculation] "
+                "enabled on the target node?")
+            hits0 = spec0["hits"]
+            misses0 = lane_misses(spec0)
+            await asyncio.sleep(max(p.duration, 2.0))
+            h1 = await self.net_height()
+            st = json.loads(await self._debug_get(node, "/status"))
+            spec1 = st["checks"]["speculation"]
+            hits1 = spec1["hits"]
+            misses1 = lane_misses(spec1)
+        finally:
+            await self._debug_post(node, "/debug/failpoint",
+                                   {"name": "consensus.speculate",
+                                    "action": "off"})
+        assert hits1 - hits0 == 0, (
+            f"speculation served {hits1 - hits0} hits during the "
+            "wrong-timestamp flood window")
+        assert misses1 - misses0 > 0, (
+            "no speculation misses during the flood window — the "
+            "plane wasn't exercised")
+        assert h1 >= h0 + 2, (
+            f"net stalled under spec_mismatch ({h0} -> {h1})")
+        # fallback verdicts stayed correct: the net keeps committing
+        # past the window (the final no-fork check covers the hashes)
+        await self.wait_net_height(h1 + 1, timeout=60)
+        report = {"node": p.node, "height_at_arm": h0,
+                  "hits_delta": hits1 - hits0,
+                  "misses_delta": misses1 - misses0,
+                  "height_after": h1}
+        self.spec_mismatch_reports.append(report)
+        self.log(f"perturb: spec_mismatch report {report}")
 
     async def _apply_light_proxy(self, p: Perturbation,
                                  node: NodeProc) -> None:
@@ -1145,6 +1231,8 @@ class Runner:
                 report["kill_recoveries"] = self.kill_reports
             if self.light_proxy_reports:
                 report["light_proxy"] = self.light_proxy_reports
+            if self.spec_mismatch_reports:
+                report["spec_mismatch"] = self.spec_mismatch_reports
             return report
         finally:
             self.stop_load()

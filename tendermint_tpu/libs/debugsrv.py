@@ -197,6 +197,20 @@ class HealthMonitor:
                 except Exception:  # pragma: no cover - monitor guard
                     logger.exception("light status check failed")
 
+        # -- speculation: the verify-ahead plane, when one is live in
+        # THIS process (consensus/speculation.py). Consulted only if
+        # the module is already imported (a plane can only exist
+        # then); misses are designed behavior — the check never
+        # degrades, it shows the hit/miss/overlap story. --
+        mod = sys.modules.get("tendermint_tpu.consensus.speculation")
+        if mod is not None:
+            plane = mod.active_plane()
+            if plane is not None:
+                try:
+                    checks["speculation"] = plane.status_check()
+                except Exception:  # pragma: no cover - monitor guard
+                    logger.exception("speculation status check failed")
+
         # -- device: is the accelerator serving, and is the verify
         # queue draining? Per-backend circuit-breaker states: ed25519
         # and sr25519 degrade independently. --

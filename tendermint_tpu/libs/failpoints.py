@@ -160,6 +160,15 @@ CATALOG: tuple[FailpointDef, ...] = (
         "failed launch that must degrade to the host oracle, never "
         "fail the requests)"),
     FailpointDef(
+        "consensus.speculate",
+        "a precommit lane entering a speculative verify-ahead launch "
+        "(consensus/speculation.py — payload is the lane's observed "
+        "timestamp bytes; `corrupt` models a wrong-timestamp flood so "
+        "every speculated lane mismatches at commit and falls back to "
+        "the breaker-aware verify path, `error` abandons the launch, "
+        "`delay` stalls it past the commit)",
+        payload=True),
+    FailpointDef(
         "store.save_block",
         "a block about to be persisted to the block store (one atomic "
         "batch: meta + parts + commits + store state)"),

@@ -498,6 +498,49 @@ class LightMetrics:
 
 
 @dataclass
+class SpeculationMetrics:
+    """Verify-ahead pipeline (consensus/speculation.py +
+    crypto/tpu/resident.py): commit verification launched BEFORE the
+    commit is needed, served at commit time from a byte-exact template
+    match. The hit counter is the evidence the commit-time verify
+    vanished from the critical path; overlap_seconds is how far ahead
+    the launch completed; arena/reupload bytes quantify what device
+    residency + donated buffers save per launch."""
+    hits: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "hits_total",
+        "Commits whose verdicts were fully served from a completed "
+        "speculative launch (zero verification launches on the "
+        "post-commit critical path).", "speculation"))
+    misses: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "misses_total",
+        "Speculation misses, by reason (no_plan once per unserved "
+        "commit; unpatched/mismatch/equivocation/not_launched per "
+        "fallback lane).", "speculation"))
+    patched_lanes: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "patched_lanes_total",
+        "Precommit lanes patched into the speculative batch as votes "
+        "arrived.", "speculation"))
+    launches: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "launches_total",
+        "Speculative verification launches, by backend "
+        "(device/host/host_recheck).", "speculation"))
+    overlap_seconds: Histogram = field(
+        default_factory=lambda: DEFAULT.histogram(
+            "overlap_seconds",
+            "Time between a speculative launch completing and its "
+            "verdicts being served at commit time.", "speculation"))
+    arena_bytes: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "arena_bytes",
+        "Bytes of persistent device-resident verify buffers "
+        "(crypto/tpu/resident.py ResidentArena).", "speculation"))
+    reupload_bytes: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "resident_reupload_bytes_total",
+        "Host-to-device bytes actually shipped by arena delta splices "
+        "and per-launch templates (vs re-transferring every lane).",
+        "speculation"))
+
+
+@dataclass
 class BlockchainMetrics:
     """Fast-sync pool instrumentation (reference has no blocksync
     metrics in v0.34; names follow the pool's own vocabulary)."""
@@ -765,6 +808,10 @@ def light_metrics() -> LightMetrics:
     return _singleton("light", LightMetrics)
 
 
+def speculation_metrics() -> SpeculationMetrics:
+    return _singleton("speculation", SpeculationMetrics)
+
+
 def blockchain_metrics() -> BlockchainMetrics:
     return _singleton("blockchain", BlockchainMetrics)
 
@@ -824,6 +871,7 @@ class NodeMetrics:
     mempool: MempoolMetrics
     admission: AdmissionMetrics
     light: LightMetrics
+    speculation: SpeculationMetrics
     blockchain: BlockchainMetrics
     statesync: StateSyncMetrics
     evidence: EvidenceMetrics
@@ -845,6 +893,7 @@ def node_metrics() -> NodeMetrics:
         consensus=consensus_metrics(), crypto=crypto_metrics(),
         p2p=p2p_metrics(), mempool=mempool_metrics(),
         admission=admission_metrics(), light=light_metrics(),
+        speculation=speculation_metrics(),
         blockchain=blockchain_metrics(), statesync=statesync_metrics(),
         evidence=evidence_metrics(), state=state_metrics(),
         abci=abci_metrics(), tpu=tpu_metrics(),

@@ -107,6 +107,14 @@ CRYPTO_DEVICE_EXEC = register_kind("crypto.device_exec")
 CRYPTO_READBACK = register_kind("crypto.readback")
 CRYPTO_HOST_VERIFY = register_kind("crypto.host_verify")
 
+# Verify-ahead pipeline (consensus/speculation.py + crypto/tpu/
+# resident.py): speculate = an ahead-of-commit verification launch,
+# patch = a delta splice into the device-resident arena, reconcile =
+# the commit-time serve (template match + miss fallback).
+SPECULATION_SPECULATE = register_kind("speculation.speculate")
+SPECULATION_PATCH = register_kind("speculation.patch")
+SPECULATION_RECONCILE = register_kind("speculation.reconcile")
+
 # State machine + durability + wire.
 STATE_APPLY_BLOCK = register_kind("state.apply_block")
 WAL_FSYNC = register_kind("wal.fsync")

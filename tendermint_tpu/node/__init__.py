@@ -226,10 +226,18 @@ class Node(Service):
             refill = await self.mempool.refill_from_wal()
             if refill["pending"]:
                 logger.info("mempool WAL refill: %s", refill)
+        # Verify-ahead plane (consensus/speculation.py): ConsensusState
+        # feeds it proposal BlockIDs + precommits, BlockExecutor serves
+        # LastCommit verdicts from its completed launches.
+        self.speculation = None
+        if cfg.speculation.enabled:
+            from ..consensus.speculation import SpeculationPlane
+
+            self.speculation = SpeculationPlane(cfg.speculation)
         self.block_exec = BlockExecutor(
             self.state_store, self.proxy_app.consensus,
             mempool=self.mempool, evidence_pool=self.evpool,
-            event_bus=self.event_bus)
+            event_bus=self.event_bus, speculation=self.speculation)
 
         wal_path = cfg.base.resolve(cfg.consensus.wal_file)
         os.makedirs(os.path.dirname(wal_path), exist_ok=True)
@@ -237,7 +245,7 @@ class Node(Service):
             cfg.consensus, self.state, self.block_exec, self.block_store,
             mempool=self.mempool, evpool=self.evpool,
             wal=None if self.in_memory else WAL(wal_path),
-            event_bus=self.event_bus)
+            event_bus=self.event_bus, speculation=self.speculation)
         self.consensus_state.misbehaviors.update(self.misbehaviors)
         if (self.priv_validator is None
                 and cfg.base.priv_validator_laddr):
@@ -293,7 +301,8 @@ class Node(Service):
         self.bc_reactor = BlockchainReactor(
             self.state, self.block_exec, self.block_store,
             fast_sync=fast_sync and not state_sync,
-            consensus_reactor=self.consensus_reactor)
+            consensus_reactor=self.consensus_reactor,
+            verify_ahead=cfg.fastsync.verify_ahead)
         self.mempool_reactor = MempoolReactor(
             self.mempool, broadcast=cfg.mempool.broadcast)
         self.ev_reactor = EvidenceReactor(self.evpool)

@@ -87,12 +87,16 @@ def build_last_commit_info(block: Block, state_store: Store,
 class BlockExecutor:
     def __init__(self, state_store: Store, app_conn: Client,
                  mempool: Mempool | None = None, evidence_pool=None,
-                 event_bus: EventBus | None = None):
+                 event_bus: EventBus | None = None, speculation=None):
         self.store = state_store
         self.app = app_conn
         self.mempool = mempool or NopMempool()
         self.evpool = evidence_pool
         self.event_bus = event_bus
+        # consensus/speculation.py SpeculationPlane (or None): lets
+        # validate_block serve the LastCommit check from a completed
+        # verify-ahead launch instead of verifying on the critical path
+        self.speculation = speculation
 
     # -- proposal construction (reference: state/execution.go:95-116) --
 
@@ -121,7 +125,8 @@ class BlockExecutor:
     # -- the apply path --
 
     def validate_block(self, state: State, block: Block) -> None:
-        validate_block(state, block, self.evpool)
+        validate_block(state, block, self.evpool,
+                       speculation=self.speculation)
 
     async def validate_block_async(self, state: State, block: Block) -> None:
         """validate_block in a worker thread: the LastCommit signature
@@ -134,7 +139,7 @@ class BlockExecutor:
         from ..libs.tracing import TRACER
 
         await asyncio.get_running_loop().run_in_executor(
-            None, TRACER.wrap(validate_block), state, block, self.evpool
+            None, TRACER.wrap(self.validate_block), state, block
         )
 
     async def apply_block(self, state: State, block_id: BlockID,

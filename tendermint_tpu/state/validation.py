@@ -16,7 +16,8 @@ class BlockValidationError(Exception):
     pass
 
 
-def validate_block(state: State, block: Block, evidence_pool=None) -> None:
+def validate_block(state: State, block: Block, evidence_pool=None,
+                   speculation=None) -> None:
     block.validate_basic()
     h = block.header
 
@@ -75,10 +76,22 @@ def validate_block(state: State, block: Block, evidence_pool=None) -> None:
 
         try:
             with state_metrics().commit_verify_seconds.time():
-                state.last_validators.verify_commit(
-                    state.chain_id, state.last_block_id, h.height - 1,
-                    block.last_commit,
-                )
+                # Verify-ahead serve point (consensus/speculation.py):
+                # a speculation hit answers from the launch that ran
+                # while the precommits were still arriving — zero
+                # verification launches here; misses (and commits the
+                # plane never saw) take the ordinary batched path.
+                served = False
+                if speculation is not None:
+                    served = speculation.serve_commit(
+                        state.last_validators, state.chain_id,
+                        state.last_block_id, h.height - 1,
+                        block.last_commit)
+                if not served:
+                    state.last_validators.verify_commit(
+                        state.chain_id, state.last_block_id,
+                        h.height - 1, block.last_commit,
+                    )
         except VerificationError as e:
             raise BlockValidationError(f"invalid LastCommit: {e}") from e
 

@@ -34,8 +34,9 @@ class P2PNode:
 
     def __init__(self, gdoc, pv, moniker, fast_sync=False,
                  snapshot_interval=0, state_provider_factory=None,
-                 keep_snapshots=4):
+                 keep_snapshots=4, speculation=False):
         self.gdoc = gdoc
+        self.speculation = speculation
         self.pv = pv
         self.moniker = moniker
         self.fast_sync = fast_sync
@@ -60,11 +61,20 @@ class P2PNode:
         state = await handshake_and_load_state(
             None, state_store, self.block_store, self.gdoc, self.conns)
         self.evpool = EvidencePool(MemDB(), state_store, self.block_store)
+        spec_plane = None
+        if self.speculation:
+            from tendermint_tpu.consensus.speculation import (
+                SpeculationPlane,
+            )
+
+            spec_plane = SpeculationPlane()
         executor = BlockExecutor(state_store, self.conns.consensus,
                                  event_bus=EventBus(),
-                                 evidence_pool=self.evpool)
+                                 evidence_pool=self.evpool,
+                                 speculation=spec_plane)
         self.cs = ConsensusState(fast_consensus_config(), state, executor,
-                                 self.block_store, evpool=self.evpool)
+                                 self.block_store, evpool=self.evpool,
+                                 speculation=spec_plane)
         if self.pv is not None:
             self.cs.set_priv_validator(self.pv)
         self.reactor = ConsensusReactor(self.cs, wait_sync=wait_sync,
@@ -120,11 +130,12 @@ class P2PNode:
         await self.conns.stop()
 
 
-async def make_net(n, wait_sync_last=False):
+async def make_net(n, wait_sync_last=False, speculation=False):
     from helpers import make_genesis
 
     gdoc, pvs = make_genesis(n)
-    nodes = [P2PNode(gdoc, pvs[i], f"val{i}") for i in range(n)]
+    nodes = [P2PNode(gdoc, pvs[i], f"val{i}", speculation=speculation)
+             for i in range(n)]
     for i, node in enumerate(nodes):
         await node.start(wait_sync=(wait_sync_last and i == n - 1))
     for i in range(n):

@@ -14,12 +14,11 @@ from tendermint_tpu.blockchain.msgs import (
 from tendermint_tpu.blockchain.pool import (
     BlockPool, MAX_PENDING_PER_PEER, REQUEST_TIMEOUT,
 )
-from tendermint_tpu.blockchain.reactor import _batch_verify_window
+from tendermint_tpu.blockchain.verify_ahead import _batch_verify_window
 from tendermint_tpu.types.block import BlockID
 from tendermint_tpu.types.validator_set import VerificationError
 
 from helpers import make_genesis_state_and_pvs, sign_commit
-from p2p_harness import P2PNode, make_net
 
 
 def run(coro):
@@ -148,6 +147,13 @@ def test_batch_verify_window_pinpoints_bad_block():
 # --- end-to-end fast sync over TCP -------------------------------------------
 
 def test_fastsync_catches_up_then_joins_consensus():
+    # function-local on purpose: the TCP harness needs the optional
+    # `cryptography` package, and importing it at module scope took
+    # the pool/codec/window tests down with it at collection — the
+    # whole point of the p2p-free verify_ahead module split
+    pytest.importorskip("cryptography")
+    from p2p_harness import P2PNode
+
     async def go():
         from helpers import make_genesis
 

@@ -26,9 +26,11 @@ from helpers import deterministic_pv, make_genesis
 class Node:
     """One in-process validator node (stores + app + consensus)."""
 
-    def __init__(self, gdoc, pv, tmp_path=None, tag=""):
+    def __init__(self, gdoc, pv, tmp_path=None, tag="",
+                 speculation=False):
         self.gdoc = gdoc
         self.pv = pv
+        self.speculation = speculation
         if tmp_path is not None:
             self.state_db = FileDB(str(tmp_path / f"state{tag}.db"))
             self.block_db = FileDB(str(tmp_path / f"blocks{tag}.db"))
@@ -52,12 +54,20 @@ class Node:
             None, state_store, block_store, self.gdoc, self.conns,
         )
         self.event_bus = EventBus()
+        spec_plane = None
+        if self.speculation:
+            from tendermint_tpu.consensus.speculation import (
+                SpeculationPlane,
+            )
+
+            spec_plane = SpeculationPlane()
         executor = BlockExecutor(state_store, self.conns.consensus,
-                                 event_bus=self.event_bus)
+                                 event_bus=self.event_bus,
+                                 speculation=spec_plane)
         wal = WAL(self.wal_path) if self.wal_path else None
         self.cs = ConsensusState(
             fast_consensus_config(), state, executor, block_store,
-            wal=wal, event_bus=self.event_bus,
+            wal=wal, event_bus=self.event_bus, speculation=spec_plane,
         )
         self.cs.set_priv_validator(self.pv)
         await self.cs.start()

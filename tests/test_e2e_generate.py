@@ -41,7 +41,8 @@ def test_space_coverage():
     assert any(vu.power == 0 for m in ms for vu in m.validator_updates)
     ops = {p.op for m in ms for p in m.perturbations}
     assert ops == {"kill", "pause", "disconnect", "disconnect_hard",
-                   "restart", "chaos", "overload", "light_proxy"}
+                   "restart", "chaos", "overload", "light_proxy",
+                   "spec_mismatch"}
     # sampled chaos ops carry a complete, valid failpoint spec
     assert all(p.failpoint and p.action in ("error", "delay", "corrupt")
                for m in ms for p in m.perturbations if p.op == "chaos")

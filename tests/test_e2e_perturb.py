@@ -370,6 +370,36 @@ def test_combined_matrix_dimensions(tmp_path):
 
 
 @pytest.mark.slow
+def test_spec_mismatch_perturbation(tmp_path):
+    """ISSUE 8 degradation contract, subprocess edition: a
+    wrong-timestamp flood into one node's verify-ahead plane
+    (`consensus.speculate` corrupt) pins its speculation hits to zero
+    for the window while the fallback path keeps every commit verdict
+    correct — the net keeps committing and finishes without forking.
+    The runner's _apply_spec_mismatch does the hit/miss delta
+    assertions; this test pins the report shape + overall liveness."""
+    m = Manifest.from_dict({
+        "chain_id": "specmm-chain",
+        "nodes": 4,
+        "wait_height": 7,
+        "timeout_commit_ms": 150,
+        "perturbations": [
+            {"node": 1, "op": "spec_mismatch", "at_height": 3,
+             "duration": 3.0},
+        ],
+    })
+    runner = Runner(m, str(tmp_path / "net"), base_port=28900,
+                    log=lambda s: None)
+    report = asyncio.run(asyncio.wait_for(runner.run(), timeout=3000))
+    assert report["ok"] and report["nodes"] == 4
+    assert len(runner.spec_mismatch_reports) == 1
+    srep = runner.spec_mismatch_reports[0]
+    assert srep["hits_delta"] == 0
+    assert srep["misses_delta"] > 0
+    assert srep["height_after"] >= srep["height_at_arm"] + 2
+
+
+@pytest.mark.slow
 def test_overload_perturbation(tmp_path):
     """ISSUE 4 acceptance, subprocess edition: a node under a
     sustained broadcast_tx_async flood with an injected device.verify

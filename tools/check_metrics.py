@@ -34,8 +34,9 @@ DOCS = os.path.join(REPO, "docs", "OBSERVABILITY.md")
 # docs/nodes/metrics.md module list.
 NAMESPACES = {
     "consensus", "crypto", "p2p", "mempool", "admission", "light",
-    "blockchain", "statesync", "evidence", "state", "abci", "tpu",
-    "tracing", "failpoint", "rpc", "overload", "recovery",
+    "speculation", "blockchain", "statesync", "evidence", "state",
+    "abci", "tpu", "tracing", "failpoint", "rpc", "overload",
+    "recovery",
 }
 
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")

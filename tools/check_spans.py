@@ -42,6 +42,27 @@ DISABLED_BUDGET_S = 10e-6
 
 _SPAN_METHODS = {"span", "begin"}
 
+# Stage-taxonomy kinds the rollup/export surfaces (BENCH
+# stage_breakdown, /debug/trace/rollup, the tracer-pinned acceptance
+# tests) depend on BY NAME: renaming or dropping one silently empties
+# a dashboard row, so their registration is linted, not assumed.
+REQUIRED_KINDS = frozenset({
+    "consensus.height", "consensus.commit", "consensus.vote_batch",
+    "crypto.batch", "crypto.verify", "crypto.pack", "crypto.dispatch",
+    "crypto.device_exec", "crypto.readback", "crypto.host_verify",
+    "speculation.speculate", "speculation.patch",
+    "speculation.reconcile",
+    "state.apply_block", "wal.fsync",
+})
+
+
+def missing_required_kinds() -> list[str]:
+    """REQUIRED_KINDS entries absent from the live registry (empty =
+    clean). Imported lazily so the lint half stays import-free."""
+    from tendermint_tpu.libs import tracing
+
+    return sorted(REQUIRED_KINDS - tracing.registered_kinds())
+
 
 def find_ad_hoc_spans(root: str = PKG) -> list[str]:
     """Call sites passing a string LITERAL as the span kind. Returns
@@ -118,6 +139,8 @@ def measure_overhead(n: int = 20000) -> tuple[float, float]:
 def main() -> int:
     sys.path.insert(0, REPO)
     problems = find_ad_hoc_spans()
+    problems += [f"required span kind {k!r} not registered "
+                 "(libs/tracing.py)" for k in missing_required_kinds()]
     for p in problems:
         print(f"LINT: {p}")
     enabled, disabled = measure_overhead()

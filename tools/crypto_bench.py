@@ -23,6 +23,74 @@ def timeit(f, reps):
     return (time.perf_counter() - t0) / reps
 
 
+def _resident_ab(batch: int):
+    """A/B the verify-ahead transfer story on real commit-shaped
+    lanes: (a) a FRESH launch re-ships every lane's pubkey + signature
+    + sign bytes (the general kernel path), (b) the ResidentArena
+    splices a small per-height delta into donated device-resident
+    buffers and relaunches. Prints per-launch latency plus the bytes
+    each path actually uploads."""
+    import numpy as np
+
+    from tendermint_tpu.crypto import ed25519_ref as ref
+    from tendermint_tpu.crypto.tpu import verify as tv
+    from tendermint_tpu.crypto.tpu.resident import ResidentArena
+    from tendermint_tpu.types import canonical, sign_batch as sbm
+    from tendermint_tpu.types.vote import VoteType
+
+    n = batch
+    delta = max(1, min(64, n // 16))
+    seeds = [hashlib.sha256(b"res%d" % i).digest() for i in range(n)]
+    pubs = [ref.public_key_from_seed(s) for s in seeds]
+    from tendermint_tpu.types.block import BlockID, PartSetHeader
+
+    bid = BlockID(b"\xab" * 32, PartSetHeader(4, b"\xcd" * 32))
+    pre, suf = canonical.vote_sign_parts(
+        "bench-chain", int(VoteType.PRECOMMIT), 123456, 0, bid)
+    base_ts = 1_753_928_000_000_000_000
+    ts = np.asarray([base_ts + i * 1_000_003 for i in range(n)],
+                    np.int64)
+    msgs = [canonical.vote_sign_bytes(
+        "bench-chain", int(VoteType.PRECOMMIT), 123456, 0, bid,
+        int(t)) for t in ts]
+    sigs = [ref.sign(s, m) for s, m in zip(seeds, msgs)]
+
+    arena = ResidentArena(n + 1)
+    arena.install_keys(pubs)
+    arena.set_template(1, pre, suf)
+    group = np.ones(n, np.int32)
+    patch, split, patch_len = sbm._build_patches(
+        arena.pre_len.astype(np.int64), arena.suf_len, group, ts)
+    sig_rows = np.frombuffer(b"".join(sigs), np.uint8).reshape(n, 64)
+    slots = list(range(1, n + 1))
+    arena.splice(slots, sig_rows, patch, split, patch_len, group)
+    out = arena.launch()  # compile + warm
+    assert bool(out[0]) and bool(out[1:n + 1].all()), \
+        "resident arena lanes must verify"
+    tv.verify_batch(pubs[:n], msgs[:n], sigs[:n])  # warm fresh path
+
+    def resident_relaunch():
+        lo = arena.reupload_bytes
+        arena.splice(slots[:delta], sig_rows[:delta], patch[:delta],
+                     split[:delta], patch_len[:delta], group[:delta])
+        arena.launch()
+        return arena.reupload_bytes - lo
+
+    fresh_bytes = n * (32 + 64) + sum(len(m) for m in msgs)
+    t_fresh = timeit(
+        lambda: tv.verify_batch(pubs, msgs, sigs), 3)
+    lo = arena.reupload_bytes
+    t_res = timeit(resident_relaunch, 3)
+    res_bytes = (arena.reupload_bytes - lo) // 3
+    print(f"resident A/B x{n}: fresh ~{fresh_bytes} B/launch, "
+          f"resident delta={delta} lanes ~{res_bytes} B/launch "
+          f"({fresh_bytes / max(res_bytes, 1):.0f}x less transfer)")
+    return [
+        (f"ed25519 fresh-transfer launch x{n}", t_fresh),
+        (f"ed25519 resident relaunch x{n} (delta {delta})", t_res),
+    ]
+
+
 def main():
     if "--cpu" in sys.argv:
         from tendermint_tpu.libs.cpuforce import force_cpu_backend
@@ -90,6 +158,11 @@ def main():
     verify_batch_sr(spubs, msgs[:n_sr], ssigs)  # compile
     t = timeit(lambda: verify_batch_sr(spubs, msgs[:n_sr], ssigs), 3)
     rows.append((f"sr25519 device batch x{n_sr} (per sig)", t / n_sr))
+
+    # -- resident-arena A/B: donated device-resident buffers vs fresh
+    # full-transfer launches over the same commit-shaped lanes --
+    if "--resident" in sys.argv:
+        rows.extend(_resident_ab(batch))
 
     import jax
 

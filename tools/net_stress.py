@@ -19,6 +19,15 @@ node0's consensus funnel — the net must still reach the target height
 with shed counters climbing and every tracked queue inside its bound.
 
     python tools/net_stress.py --overload [--runs 20] [--flood-rate 500]
+
+--speculation runs each net with the verify-ahead plane enabled
+(consensus/speculation.py) and, after the target height, pins the
+claim against the tracer rollup: speculation hits happened on every
+node, reconcile spans were recorded for them, and a hit's commit-time
+verify is reconcile-only (the hit counter only moves when ZERO
+fallback lanes verified at commit).
+
+    python tools/net_stress.py --speculation [--runs 10]
 """
 
 import asyncio
@@ -55,13 +64,23 @@ def _dump(nodes) -> None:
 
 async def one(i: int, misbehavior: str, target_h: int,
               stall_s: float, overload: bool = False,
-              flood_rate: float = 500.0) -> bool:
+              flood_rate: float = 500.0,
+              speculation: bool = False) -> bool:
     from p2p_harness import make_net
 
     from tendermint_tpu.consensus.misbehavior import MISBEHAVIORS
 
-    nodes = await make_net(4)
+    nodes = await make_net(4, speculation=speculation)
     flood_task = None
+    spec_rec0 = 0
+    if speculation:
+        # the TRACER ring is process-global and survives across runs:
+        # the reconcile-span pin must compare DELTAS or every run
+        # after the first trivially passes on run 0's spans
+        from tendermint_tpu.libs.tracing import TRACER
+
+        spec_rec0 = TRACER.stage_rollup(prefix="speculation.").get(
+            "speculation.reconcile", {}).get("count", 0)
     try:
         if overload:
             from tendermint_tpu.consensus import messages as cm
@@ -102,6 +121,8 @@ async def one(i: int, misbehavior: str, target_h: int,
             view = tuple((n.cs.rs.height, n.cs.rs.round,
                           int(n.cs.rs.step)) for n in nodes)
             if all(h >= target_h for h, _, _ in view):
+                if speculation:
+                    return _check_speculation(i, nodes, spec_rec0)
                 return True
             now = time.monotonic()
             if view != last_view:
@@ -129,9 +150,45 @@ async def one(i: int, misbehavior: str, target_h: int,
                 pass
 
 
+def _check_speculation(i: int, nodes, rec0: int = 0) -> bool:
+    """Pin the verify-ahead contract against the tracer rollup: the
+    net produced speculation hits, and every hit's commit-time verify
+    was reconcile-only — the hit counter only moves when ZERO fallback
+    lanes verified at commit, and the rollup must show the reconcile
+    spans those serves recorded. `rec0` is the reconcile-span count
+    before this run (the ring is process-global): only the DELTA
+    counts, so the pin stays meaningful on every run, not just run 0."""
+    from tendermint_tpu.libs.tracing import TRACER
+
+    hits = sum(n.cs.speculation.hits for n in nodes
+               if n.cs.speculation is not None)
+    misses: dict[str, int] = {}
+    for n in nodes:
+        if n.cs.speculation is None:
+            continue
+        for k, v in n.cs.speculation.misses.items():
+            if v:
+                misses[k] = misses.get(k, 0) + v
+    rec = TRACER.stage_rollup(prefix="speculation.").get(
+        "speculation.reconcile", {})
+    rec_delta = rec.get("count", 0) - rec0
+    print(f"  run {i}: speculation hits={hits} misses={misses} "
+          f"reconcile spans={rec_delta} "
+          f"p50={rec.get('p50_ms', 0)}ms", flush=True)
+    if hits == 0:
+        print(f"RUN {i} FAILED: no speculation hits", flush=True)
+        return False
+    if rec_delta < hits:
+        print(f"RUN {i} FAILED: {hits} hits but only "
+              f"{rec_delta} new reconcile spans in the rollup",
+              flush=True)
+        return False
+    return True
+
+
 async def main() -> int:
     runs, mis, target_h, stall = 100, "", 4, 25.0
-    overload, flood_rate = False, 500.0
+    overload, flood_rate, speculation = False, 500.0, False
     args = sys.argv
     for i, a in enumerate(args):
         if a == "--runs":
@@ -146,6 +203,8 @@ async def main() -> int:
             overload = True
         elif a == "--flood-rate":
             flood_rate = float(args[i + 1])
+        elif a == "--speculation":
+            speculation = True
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -153,12 +212,14 @@ async def main() -> int:
     t0 = time.monotonic()
     for i in range(runs):
         if not await one(i, mis, target_h, stall, overload=overload,
-                         flood_rate=flood_rate):
+                         flood_rate=flood_rate,
+                         speculation=speculation):
             wedges += 1
         if (i + 1) % 25 == 0:
             print(f"progress: {i + 1}/{runs}, {wedges} wedges, "
                   f"{time.monotonic() - t0:.0f}s", flush=True)
-    label = "overload" if overload else (mis or "clean")
+    label = "overload" if overload else (
+        "speculation" if speculation else (mis or "clean"))
     print(f"net_stress [{label}]: {wedges} wedges / {runs} runs")
     return 1 if wedges else 0
 
