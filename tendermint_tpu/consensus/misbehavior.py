@@ -19,7 +19,7 @@ attackers.
 
 from __future__ import annotations
 
-import time as _time
+from ..libs import clock as _clock
 
 from ..types.block import BlockID
 from ..types.proposal import Proposal
@@ -65,7 +65,7 @@ def _make_vote(cs, type_: VoteType, hash_: bytes, psh) -> Vote:
         height=cs.rs.height,
         round=cs.rs.round,
         block_id=BlockID(hash_, psh) if hash_ else None,
-        timestamp=_time.time_ns(),
+        timestamp=_clock.time_ns(),
         validator_address=cs.priv_validator_address,
         validator_index=idx,
     )
@@ -143,7 +143,7 @@ class DoublePropose(Misbehavior):
             prop = Proposal(
                 height=height, round=round_, pol_round=rs.valid_round,
                 block_id=BlockID(block.hash(), parts.header()),
-                timestamp=_time.time_ns(),
+                timestamp=_clock.time_ns(),
             )
             prop.signature = priv.sign(
                 prop.sign_bytes(cs.state.chain_id))

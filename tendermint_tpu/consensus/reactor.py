@@ -17,8 +17,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import time
 
+from ..libs import clock
 from ..libs.bits import BitArray
 from ..p2p.conn.connection import ChannelDescriptor
 from ..p2p.switch import Reactor
@@ -128,7 +128,7 @@ class PeerState:
         self.height = msg.height
         self.round = msg.round
         self.step = RoundStep(msg.step)
-        self.start_time = time.monotonic() - msg.seconds_since_start_time
+        self.start_time = clock.monotonic() - msg.seconds_since_start_time
         if ph != msg.height or pr != msg.round:
             self.proposal = False
             self.proposal_block_parts_header = None
@@ -244,7 +244,7 @@ def _new_round_step_msg(rs: RoundState) -> m.NewRoundStepMessage:
     lcr = rs.last_commit.round if rs.last_commit is not None else -1
     return m.NewRoundStepMessage(
         height=rs.height, round=rs.round, step=int(rs.step),
-        seconds_since_start_time=max(0, int(time.monotonic() -
+        seconds_since_start_time=max(0, int(clock.monotonic() -
                                             rs.start_time)),
         last_commit_round=lcr)
 
@@ -517,8 +517,8 @@ class ConsensusReactor(Reactor):
                 if rs.step == RoundStep.COMMIT and \
                         rs.proposal_block is None and \
                         rs.proposal_block_parts is not None and \
-                        time.monotonic() - last_advert > 1.0:
-                    last_advert = time.monotonic()
+                        clock.monotonic() - last_advert > 1.0:
+                    last_advert = clock.monotonic()
                     await peer.send(
                         STATE_CHANNEL,
                         m.encode_consensus_msg(_new_valid_block_msg(
@@ -557,7 +557,15 @@ class ConsensusReactor(Reactor):
                 proposal = rs.proposal
                 parts = rs.proposal_block_parts
                 votes = rs.votes
+                # Round must match set_proposal's acceptance guard
+                # (PeerState.set_proposal drops a proposal for another
+                # round WITHOUT latching ps.proposal): sending on a
+                # round mismatch re-sent the same proposal every
+                # iteration with no sleep — a CPU-burning spin against
+                # any peer sitting in a different round, found the
+                # moment the sim harness made gossip time virtual.
                 if rs.height == ps.height and proposal is not None \
+                        and ps.round == proposal.round \
                         and not ps.proposal:
                     await peer.send(DATA_CHANNEL, m.encode_consensus_msg(
                         m.ProposalMessage(proposal)))

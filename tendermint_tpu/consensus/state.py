@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import asyncio
 import time as _time
+
+from ..libs import clock as _clock
 from dataclasses import dataclass
 
 from ..config import ConsensusConfig
@@ -159,7 +161,7 @@ class ConsensusState(Service):
     def _schedule_round0(self) -> None:
         # fire NewHeight immediately (start_time already accounts for
         # timeout_commit when coming off a commit)
-        delay = max(self.rs.start_time - _time.monotonic(), 0.0)
+        delay = max(self.rs.start_time - _clock.monotonic(), 0.0)
         self.ticker.schedule(TimeoutInfo(
             delay, self.rs.height, 0, int(RoundStep.NEW_HEIGHT)
         ))
@@ -189,7 +191,7 @@ class ConsensusState(Service):
             height=height,
             round=0,
             step=RoundStep.NEW_HEIGHT,
-            start_time=_time.monotonic() + (
+            start_time=_clock.monotonic() + (
                 self.config.commit_timeout()
                 if not self.config.skip_timeout_commit and rs.commit_round > -1
                 else 0.0
@@ -315,11 +317,11 @@ class ConsensusState(Service):
 
     def _wal_write(self, msg) -> None:
         if self.wal is not None and not self._replay_mode:
-            self.wal.write(msg, _time.time_ns())
+            self.wal.write(msg, _clock.time_ns())
 
     def _wal_write_sync(self, msg) -> None:
         if self.wal is not None and not self._replay_mode:
-            self.wal.write_sync(msg, _time.time_ns())
+            self.wal.write_sync(msg, _clock.time_ns())
 
     async def _handle_msg(self, qm: _QueuedMsg) -> None:
         """Validation failures on a single message are logged and
@@ -484,7 +486,7 @@ class ConsensusState(Service):
         block_id = BlockID(block.hash(), parts.header())
         proposal = Proposal(
             height=height, round=round_, pol_round=rs.valid_round,
-            block_id=block_id, timestamp=_time.time_ns(),
+            block_id=block_id, timestamp=_clock.time_ns(),
         )
         try:
             res = self.priv_validator.sign_proposal(self.state.chain_id,
@@ -661,7 +663,7 @@ class ConsensusState(Service):
         if rs.height != height or rs.step >= RoundStep.COMMIT:
             return
         rs.commit_round = commit_round
-        rs.commit_time = _time.monotonic()
+        rs.commit_time = _clock.monotonic()
         self._new_step(RoundStep.COMMIT)
 
         precommits = rs.votes.precommits(commit_round)
@@ -727,7 +729,7 @@ class ConsensusState(Service):
         _failpoint("consensus.commit.block_saved")
 
         if self.wal is not None and not self._replay_mode:
-            self.wal.write_sync(EndHeightMessage(height), _time.time_ns())
+            self.wal.write_sync(EndHeightMessage(height), _clock.time_ns())
 
         _failpoint("consensus.commit.wal_delimited")
 
@@ -1322,7 +1324,7 @@ class ConsensusState(Service):
     def _vote_time(self) -> int:
         """now, but strictly after the block we're voting on
         (reference voteTime, state.go:2120)."""
-        now = _time.time_ns()
+        now = _clock.time_ns()
         time_iota = max(
             self.state.consensus_params.block.time_iota_ms, 1
         ) * 1_000_000
@@ -1421,9 +1423,9 @@ class ConsensusState(Service):
         return self.rs
 
     async def wait_for_height(self, height: int, timeout: float = 60.0) -> None:
-        deadline = _time.monotonic() + timeout
+        deadline = _clock.monotonic() + timeout
         while self.rs.height <= height:
-            remaining = deadline - _time.monotonic()
+            remaining = deadline - _clock.monotonic()
             if remaining <= 0:
                 raise TimeoutError(
                     f"height {height} not reached (at {self.rs.height})"

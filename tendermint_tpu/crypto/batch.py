@@ -21,7 +21,8 @@ import functools
 import hashlib
 import logging
 import threading
-import time
+
+from ..libs import clock
 
 import numpy as np
 
@@ -94,7 +95,7 @@ class CircuitBreaker:
     def cooldown_remaining(self) -> float:
         if self.state == CLOSED:
             return 0.0
-        return max(0.0, self._open_until - time.monotonic())
+        return max(0.0, self._open_until - clock.monotonic())
 
     # -- transitions --
 
@@ -114,7 +115,7 @@ class CircuitBreaker:
         cd = jittered_backoff(max(self.consecutive_failures - 1, 0),
                               BREAKER_BASE_COOLDOWN_S,
                               BREAKER_MAX_COOLDOWN_S)
-        self._open_until = time.monotonic() + cd
+        self._open_until = clock.monotonic() + cd
         self._set_state(OPEN)
         from ..libs.metrics import crypto_metrics
 
@@ -137,7 +138,7 @@ class CircuitBreaker:
         with self._lock:
             if self.state == CLOSED:
                 return True
-            if self._probing or time.monotonic() < self._open_until:
+            if self._probing or clock.monotonic() < self._open_until:
                 return False
             self._probing = True
             self._set_state(HALF_OPEN)
@@ -235,6 +236,26 @@ def reset_breakers() -> None:
         b.reset()
 
 
+# Host-only override (tendermint_tpu/sim): a deterministic simulation
+# pins every verification to the host oracle — per-lane verdicts are
+# a pure function of the inputs with no device runtime in the loop —
+# unless the scenario explicitly exercises the device verifier.
+_FORCE_HOST = False
+
+
+def set_force_host(on: bool) -> bool:
+    """Pin batch verification to the host path (returns the previous
+    setting so callers can restore it)."""
+    global _FORCE_HOST
+    prev = _FORCE_HOST
+    _FORCE_HOST = bool(on)
+    return prev
+
+
+def host_forced() -> bool:
+    return _FORCE_HOST
+
+
 def device_available(backend: str | None = None) -> bool:
     """Pure read (never probes): is the backend's breaker closed? With
     no backend, True only when EVERY breaker is closed (the legacy
@@ -301,7 +322,8 @@ class BatchVerifier:
         if type_name == "ed25519":
             use_dev = self._use_device
             if use_dev is None:
-                use_dev = len(items) >= _DEVICE_THRESHOLD
+                use_dev = (not _FORCE_HOST
+                           and len(items) >= _DEVICE_THRESHOLD)
             if use_dev and breaker("ed25519").acquire():
                 try:
                     from ..libs import failpoints
@@ -344,7 +366,8 @@ class BatchVerifier:
         if type_name == "sr25519":
             use_dev = self._use_device
             if use_dev is None:
-                use_dev = len(items) >= _DEVICE_THRESHOLD_SR
+                use_dev = (not _FORCE_HOST
+                           and len(items) >= _DEVICE_THRESHOLD_SR)
             if use_dev and breaker("sr25519").acquire():
                 try:
                     from ..libs import failpoints

@@ -32,8 +32,13 @@ from .manifest import (
 )
 
 
-def generate(rng: random.Random) -> Manifest:
-    """Sample one valid Manifest."""
+def generate(rng: random.Random, seed: int | None = None) -> Manifest:
+    """Sample one valid Manifest.
+
+    `seed` is the value `rng` was constructed from; when given it is
+    stamped into the manifest (and therefore the run report) so the
+    manifest reproduces from the report alone.
+    """
     nodes = rng.choice([1, 2, 3, 3, 4, 4, 4, 5, 6])
     wait_height = rng.randint(6, 10)
     abci = rng.choice(["builtin", "builtin", "builtin", "tcp", "grpc"])
@@ -54,6 +59,7 @@ def generate(rng: random.Random) -> Manifest:
         privval=privval,
         seed_bootstrap=seed_bootstrap,
         late_statesync_node=late_statesync,
+        generator_seed=seed,
     )
 
     # Perturbations: probabilistically per node (reference
@@ -170,6 +176,8 @@ def to_toml(m: Manifest) -> str:
         f"late_statesync_node = "
         f"{'true' if m.late_statesync_node else 'false'}",
     ]
+    if m.generator_seed is not None:
+        out += [f"generator_seed = {m.generator_seed}"]
     for p in m.perturbations:
         out += ["", "[[perturbations]]", f"node = {p.node}",
                 f'op = "{p.op}"', f"at_height = {p.at_height}",
@@ -203,7 +211,7 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--out", default="-",
                     help="output path ('-' = stdout)")
     args = ap.parse_args(argv)
-    toml = to_toml(generate(random.Random(args.seed)))
+    toml = to_toml(generate(random.Random(args.seed), seed=args.seed))
     if args.out == "-":
         print(toml, end="")
     else:

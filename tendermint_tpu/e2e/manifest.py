@@ -214,6 +214,11 @@ class Manifest:
     # with state sync configured from a live trust hash and make it
     # catch up (reference manifest state_sync node role).
     late_statesync_node: bool = False
+    # The generator seed this manifest was sampled from (e2e/generate
+    # stamps it; None for hand-written manifests). Carried into the
+    # run report so ANY generated run reproduces from its report alone:
+    #   python -m tendermint_tpu.e2e.generate --seed <generator_seed>
+    generator_seed: int | None = None
 
     def validate(self) -> None:
         if self.nodes < 1:
@@ -286,7 +291,8 @@ class Manifest:
                        "load_tx_rate", "timeout_commit_ms",
                        "perturbations", "misbehaviors",
                        "validator_updates", "late_statesync_node",
-                       "abci", "privval", "seed_bootstrap"})
+                       "abci", "privval", "seed_bootstrap",
+                       "generator_seed"})
     _PERTURB_KEYS = frozenset({"node", "op", "at_height", "duration",
                                "failpoint", "action", "delay_ms",
                                "tx_rate", "tx_signed", "tx_garbage"})
@@ -350,6 +356,9 @@ class Manifest:
             abci=d.get("abci", "builtin"),
             privval=d.get("privval", "file"),
             seed_bootstrap=bool(d.get("seed_bootstrap", False)),
+            generator_seed=(int(d["generator_seed"])
+                            if d.get("generator_seed") is not None
+                            else None),
         )
         m.validate()
         return m

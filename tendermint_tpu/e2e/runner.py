@@ -1227,6 +1227,10 @@ class Runner:
             report = await self.check()
             report["txs_sent"] = self._txs_sent
             report["valset_changes"] = self._valset_changes
+            if self.m.generator_seed is not None:
+                # reproduce this exact net from the report alone:
+                #   python -m tendermint_tpu.e2e.generate --seed <it>
+                report["generator_seed"] = self.m.generator_seed
             if self.kill_reports:
                 report["kill_recoveries"] = self.kill_reports
             if self.light_proxy_reports:

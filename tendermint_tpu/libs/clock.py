@@ -1,0 +1,55 @@
+"""Process-wide time source seam (sim/clock.py virtual time plugs in
+here).
+
+Production code that consults the wall clock for CONTROL FLOW —
+consensus round start times, breaker cooldowns, token-bucket refill,
+trust-metric interval ticks, flow-rate EWMA windows, overload shed
+windows — reads it through this module instead of `time` directly.
+By default every call is a thin shim over the stdlib (one module
+global load + an is-None check on the hot path). When a simulation
+installs a virtual clock (tendermint_tpu/sim), ALL of those call
+sites advance on simulated time together, coherently with the sim
+event loop's own `loop.time()`: a scenario's "30 seconds of
+partition" costs milliseconds of wall clock and is deterministic
+under its seed.
+
+Deliberately NOT routed through here: pure-measurement reads
+(`perf_counter` for metrics/span durations) — they never steer
+control flow, and wall-clock durations are exactly what an operator
+wants on a dashboard even inside a simulation.
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+# The installed source must provide monotonic() -> float seconds and
+# time_ns() -> int nanoseconds since the unix epoch, mutually
+# coherent (time_ns advances iff monotonic does).
+_source = None
+
+
+def install(source) -> None:
+    """Install a virtual time source (sim use; tests must uninstall)."""
+    global _source
+    _source = source
+
+
+def uninstall() -> None:
+    global _source
+    _source = None
+
+
+def installed():
+    """The active virtual source, or None under real time."""
+    return _source
+
+
+def monotonic() -> float:
+    s = _source
+    return _time.monotonic() if s is None else s.monotonic()
+
+
+def time_ns() -> int:
+    s = _source
+    return _time.time_ns() if s is None else s.time_ns()

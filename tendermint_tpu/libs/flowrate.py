@@ -7,7 +7,7 @@ under the target rate; the caller sleeps when 0.
 
 from __future__ import annotations
 
-import time
+from . import clock
 
 
 class Monitor:
@@ -16,7 +16,7 @@ class Monitor:
         self._alpha = sample_period / window
         self._rate = 0.0
         self._sample_bytes = 0
-        self._sample_start = time.monotonic()
+        self._sample_start = clock.monotonic()
         self.total = 0
         self.start_time = self._sample_start
         self._tokens = 0.0
@@ -25,7 +25,7 @@ class Monitor:
     def update(self, n: int) -> None:
         self.total += n
         self._sample_bytes += n
-        now = time.monotonic()
+        now = clock.monotonic()
         elapsed = now - self._sample_start
         if elapsed >= self._period:
             inst = self._sample_bytes / elapsed
@@ -52,7 +52,7 @@ class Monitor:
         return allowed
 
     def _refill(self, rate_limit: int) -> None:
-        now = time.monotonic()
+        now = clock.monotonic()
         if self._token_time is None:
             self._tokens = float(rate_limit)  # full initial burst
         else:

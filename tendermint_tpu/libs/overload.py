@@ -33,7 +33,8 @@ from __future__ import annotations
 
 import asyncio
 import collections
-import time
+
+from . import clock
 from dataclasses import dataclass
 
 # Closed catalog of tracked bounded queues. Names label the
@@ -127,10 +128,10 @@ class OverloadController:
 
         overload_metrics().shed.inc(n, queue=queue)
         if not advisory:
-            self._last_shed = time.monotonic()
+            self._last_shed = clock.monotonic()
 
     def recent_shed(self) -> bool:
-        return time.monotonic() - self._last_shed < self.shed_window_s
+        return clock.monotonic() - self._last_shed < self.shed_window_s
 
     # -- aggregation --
 

@@ -12,10 +12,9 @@ default 500 KB/s each way).
 from __future__ import annotations
 
 import asyncio
-import time
 from dataclasses import dataclass, field
 
-from ...libs import failpoints, flowrate, tracing
+from ...libs import clock, failpoints, flowrate, tracing
 from ...libs.overload import CONTROLLER
 from ...libs.service import Service
 from .secret_connection import DATA_MAX, SEALED_SIZE, SecretConnection
@@ -104,17 +103,21 @@ class _TokenBucket:
     def __init__(self, rate: int):
         self.rate = rate
         self.tokens = float(rate)
-        self.last = time.monotonic()
+        self.last = clock.monotonic()
 
     async def consume(self, n: int) -> None:
         while True:
-            now = time.monotonic()
+            now = clock.monotonic()
             self.tokens = min(self.rate, self.tokens + (now - self.last) * self.rate)
             self.last = now
             if self.tokens >= n:
                 self.tokens -= n
                 return
-            await asyncio.sleep((n - self.tokens) / self.rate)
+            # 1ms floor: the exact deficit can round to a sleep whose
+            # wake-up advances the clock by LESS than the deficit
+            # (float truncation), which under a virtual clock spins
+            # forever refilling ~0 tokens per iteration
+            await asyncio.sleep(max((n - self.tokens) / self.rate, 1e-3))
 
 
 class MConnection(Service):
@@ -235,7 +238,7 @@ class MConnection(Service):
     async def _send_routine(self) -> None:
         try:
             throttle = self.config.flush_throttle_ms / 1000.0
-            last_flush = time.monotonic()
+            last_flush = clock.monotonic()
             while True:
                 ch = self._pick_channel()
                 if ch is None:
@@ -268,7 +271,7 @@ class MConnection(Service):
                 # 1KB packet would serialize a block part into ~1000
                 # scheduler round-trips; drain only every flush interval,
                 # plus once when the queues run dry above.
-                now = time.monotonic()
+                now = clock.monotonic()
                 if now - last_flush >= throttle:
                     with tracing.TRACER.span(tracing.P2P_SEND_FLUSH):
                         await self.conn.drain()

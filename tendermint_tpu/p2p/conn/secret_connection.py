@@ -28,13 +28,19 @@ from __future__ import annotations
 import asyncio
 import hashlib
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey, X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives import serialization
-
 from ...crypto.ed25519 import Ed25519PrivKey, Ed25519PubKey
+
+# `cryptography` is imported lazily (first connection, not module
+# import) so the whole p2p/consensus reactor stack stays importable —
+# and the in-process SIMULATION transport (tendermint_tpu/sim), which
+# never opens a secret connection, stays runnable — in environments
+# without it. Real TCP connections still require the package.
+
+
+def _aead(key: bytes):
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+    return ChaCha20Poly1305(key)
 
 FRAME_SIZE = 1024
 DATA_MAX = FRAME_SIZE - 2
@@ -64,8 +70,8 @@ class SecretConnection:
                  remote_pubkey: Ed25519PubKey | None = None):
         self._reader = reader
         self._writer = writer
-        self._send_aead = ChaCha20Poly1305(send_key)
-        self._recv_aead = ChaCha20Poly1305(recv_key)
+        self._send_aead = _aead(send_key)
+        self._recv_aead = _aead(recv_key)
         self._send_nonce = 0
         self._recv_nonce = 0
         self.remote_pubkey = remote_pubkey
@@ -136,6 +142,11 @@ async def make_secret_connection(
 ) -> SecretConnection:
     """Run the STS handshake; returns an authenticated connection.
     reference: MakeSecretConnection (secret_connection.go:92)."""
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey, X25519PublicKey,
+    )
+
     eph_priv = X25519PrivateKey.generate()
     eph_pub = eph_priv.public_key().public_bytes(
         serialization.Encoding.Raw, serialization.PublicFormat.Raw)

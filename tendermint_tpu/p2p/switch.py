@@ -86,6 +86,10 @@ class Switch(Service):
         self._sever_until = 0.0                  # sever() test hook
         self.addr_book = None                    # set by PEX wiring
         self.reporter = None                     # behaviour.SwitchReporter
+        # Optional peer interposer (sim byzantine conduct filters):
+        # called with each freshly constructed Peer BEFORE reactors
+        # see it; returns the (possibly wrapped/patched) peer.
+        self.peer_wrapper = None
         # Slow-peer escalation: pending_send_bytes high-water strikes
         # -> skip-gossip -> demote -> disconnect (non-persistent). The
         # decision logic is the pure SlowPeerTracker; this class only
@@ -180,6 +184,8 @@ class Switch(Service):
                     on_error=self._on_peer_error,
                     outbound=outbound, persistent=persistent,
                     socket_addr=socket_addr, mconn_config=self.mconn_config)
+        if self.peer_wrapper is not None:
+            peer = self.peer_wrapper(peer) or peer
         for r in self.reactors.values():
             r.init_peer(peer)
         await peer.start()

@@ -18,7 +18,9 @@ from __future__ import annotations
 
 import json
 import math
-import time
+import time  # noqa: F401  (kept for default interval docs)
+
+from ..libs import clock
 
 _PROPORTIONAL_WEIGHT = 0.4
 _INTEGRAL_WEIGHT = 0.6
@@ -158,7 +160,7 @@ class TrustMetricStore:
         self.metrics: dict[str, TrustMetric] = {}
         self.db = db
         self.interval_s = interval_s
-        self._last_tick = time.monotonic()
+        self._last_tick = clock.monotonic()
         if db is not None:
             raw = db.get(b"trusthistory")
             if raw:
@@ -189,7 +191,7 @@ class TrustMetricStore:
     def maybe_tick(self) -> None:
         """Roll intervals for every metric when the interval elapsed
         (call from any periodic loop; cheap no-op otherwise)."""
-        now = time.monotonic()
+        now = clock.monotonic()
         while now - self._last_tick >= self.interval_s:
             self._last_tick += self.interval_s
             for m in self.metrics.values():

@@ -1,0 +1,616 @@
+"""Declarative scenarios + the deterministic runner + invariants.
+
+A Scenario is a seeded, declarative description of a net: topology,
+valset size (keyed + phantom validators), link model, a fault
+schedule (partitions/heals, node churn, link flaps), byzantine
+assignments from the sim/byzantine.py catalog, tx load, and a
+duration in VIRTUAL seconds. ``run_scenario(scenario, seed)`` builds
+the net on a fresh sim event loop, executes the schedule, then runs
+the invariant suite; every violation string embeds the
+``(scenario, seed)`` pair, which is ALL that is needed to reproduce
+the run bit-for-bit.
+
+Invariants (the INVARIANTS registry; docs/CHAOS.md table):
+
+  agreement            no two nodes commit different blocks at a height
+  app_hash_oracle      every node's executed app hash at every height
+                       equals an independent fold of the committed txs
+                       (the kvstore hash rule), so execution divergence
+                       is caught even when all nodes agree
+  liveness             the net reaches the scenario's min_height
+  liveness_after_heal  nodes resume committing after the last fault
+                       heals (the partition/churn recovery contract)
+  bounded_queues       no tracked bounded queue ever exceeds its
+                       capacity while the scenario runs
+  determinism          (checked by callers running twice) identical
+                       (scenario, seed) → identical per-height app
+                       hashes — pinned by tests and scenario_sweep.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import struct
+import time as _wall
+from dataclasses import dataclass, field
+
+from ..abci.kvstore import VALIDATOR_TX_PREFIX
+from ..crypto import batch as _batch
+from ..libs import clock as libs_clock
+from ..libs.overload import CONTROLLER
+from .byzantine import BYZANTINE_KINDS, make_byzantine
+from .clock import SimStallError, VirtualClock, new_sim_loop
+from .harness import (
+    SimNode, install_verify_memo, sim_consensus_config, sim_genesis,
+    sim_host,
+)
+from .network import LinkSpec, SimNetwork, derive_seed
+
+FAULT_KINDS = ("partition", "churn", "link_down")
+
+# name -> one-line contract; tools/check_scenarios.py lints this
+# registry against the docs/CHAOS.md invariant table.
+INVARIANTS = {
+    "agreement": "no two nodes commit different blocks at any height",
+    "app_hash_oracle": "executed app hashes match the committed-tx fold",
+    "liveness": "the net reaches the scenario's min_height",
+    "liveness_after_heal": "commits resume after the last fault heals",
+    "bounded_queues": "tracked bounded queues never exceed capacity",
+    "determinism": "same (scenario, seed) reproduces identical app hashes",
+}
+
+
+@dataclass(frozen=True)
+class Fault:
+    kind: str                  # one of FAULT_KINDS
+    at: float                  # virtual seconds from scenario start
+    duration: float = 0.0      # heal/restart happens at at+duration
+    groups: tuple = ()         # partition: tuple of tuples of node idx
+    node: int = -1             # churn: which node restarts
+    a: int = -1                # link_down endpoints
+    b: int = -1
+
+    def end(self) -> float:
+        return self.at + self.duration
+
+
+@dataclass
+class Scenario:
+    name: str
+    nodes: int = 4
+    valset_size: int | None = None  # > nodes adds phantom validators
+    power: int = 100
+    phantom_power: int = 1
+    topology: str = "full"          # "full" | "ring" | "ring+K"
+    duration: float = 20.0          # virtual seconds
+    link: LinkSpec = field(default_factory=lambda: LinkSpec(
+        latency_ms=25.0, jitter_ms=10.0))
+    faults: tuple = ()
+    # node index -> byzantine spec dict (or tuple of spec dicts):
+    # {"kind": <BYZANTINE_KINDS>, "heights": [...], "from_t": ...}
+    byzantine: dict = field(default_factory=dict)
+    tx_rate: float = 2.0            # txs per virtual second
+    min_height: int = 3
+    verify_backend: str = "host"    # "host" pins the deterministic oracle
+    gossip_sleep: float = 0.05
+    # ConsensusConfig field overrides on top of sim_consensus_config()
+    # (e.g. production-cadence timeouts for WAN-scale scenarios: wall
+    # cost tracks MESSAGES — heights and gossip ticks — not virtual
+    # seconds, so stretching virtual time is free)
+    consensus: dict = field(default_factory=dict)
+    tier: str = "smoke"             # "smoke" (tier-1 scale) | "slow"
+    # optional async probe(nodes, report) spawned beside the fault/load
+    # drivers — tests use it to sample live state (trust scores, peer
+    # sets) at virtual times without patching the runner
+    probe = None
+
+    def byzantine_specs(self) -> list:
+        out = []
+        for idx in sorted(self.byzantine):
+            specs = self.byzantine[idx]
+            if isinstance(specs, dict):
+                specs = (specs,)
+            for spec in specs:
+                out.append((idx, spec))
+        return out
+
+    def validate(self) -> None:
+        if self.nodes < 1:
+            raise ValueError("need at least one node")
+        if self.valset_size is not None and self.valset_size < self.nodes:
+            raise ValueError("valset_size must be >= nodes")
+        if self.duration <= 0:
+            raise ValueError("duration must be positive")
+        if self.verify_backend not in ("host", "device"):
+            raise ValueError(f"unknown verify_backend {self.verify_backend!r}")
+        if self.tier not in ("smoke", "slow"):
+            raise ValueError(f"unknown tier {self.tier!r}")
+        cfg = sim_consensus_config()
+        for k in self.consensus:
+            if not hasattr(cfg, k):
+                raise ValueError(f"unknown consensus override {k!r}")
+        if not (self.topology in ("full", "ring")
+                or (self.topology.startswith("ring+")
+                    and self.topology[5:].isdigit())):
+            raise ValueError(f"unknown topology {self.topology!r}")
+        self.link.validate()
+        for f in self.faults:
+            if f.kind not in FAULT_KINDS:
+                raise ValueError(f"unknown fault kind {f.kind!r}")
+            # strictly inside: a heal/restart scheduled AT the
+            # duration loses the equal-deadline tie against the run's
+            # own expiry sleep and never fires — the fault would end
+            # the run half-applied with liveness_after_heal skipped
+            if f.at < 0 or f.duration < 0 or f.end() >= self.duration:
+                raise ValueError(
+                    f"fault {f.kind} window [{f.at}, {f.end()}] must "
+                    f"end strictly before scenario duration "
+                    f"{self.duration} (the heal must get to run)")
+            if f.kind == "partition":
+                seen: set[int] = set()
+                for g in f.groups:
+                    for i in g:
+                        if not 0 <= i < self.nodes or i in seen:
+                            raise ValueError(f"bad partition groups {f.groups}")
+                        seen.add(i)
+            if f.kind == "churn" and not 0 <= f.node < self.nodes:
+                raise ValueError(f"churn node {f.node} out of range")
+            if f.kind == "link_down" and not (
+                    0 <= f.a < self.nodes and 0 <= f.b < self.nodes):
+                raise ValueError(f"link_down {f.a}-{f.b} out of range")
+        for idx, spec in self.byzantine_specs():
+            if not 0 <= idx < self.nodes:
+                raise ValueError(f"byzantine node {idx} out of range")
+            if spec.get("kind") not in BYZANTINE_KINDS:
+                raise ValueError(f"unknown byzantine kind "
+                                 f"{spec.get('kind')!r}")
+
+    def edges(self, seed: int) -> list:
+        """Deterministic topology edges [(i, j)] with i dialing j."""
+        n = self.nodes
+        if n == 1:
+            return []
+        if self.topology == "full":
+            return [(i, j) for i in range(n) for j in range(i + 1, n)]
+        edges = [(i, (i + 1) % n) for i in range(n)]
+        if self.topology.startswith("ring+"):
+            k = int(self.topology[5:])
+            rng = random.Random(derive_seed("topology", self.name, seed))
+            have = {frozenset(e) for e in edges}
+            want = k * n // 2
+            guard = 0
+            while want > 0 and guard < 100 * n:
+                guard += 1
+                i, j = rng.randrange(n), rng.randrange(n)
+                if i == j or frozenset((i, j)) in have:
+                    continue
+                have.add(frozenset((i, j)))
+                edges.append((i, j))
+                want -= 1
+        return edges
+
+
+# -- the runner -------------------------------------------------------
+
+
+def run_scenario(scenario: Scenario, seed: int) -> dict:
+    """Execute one seeded scenario on a fresh virtual-time loop and
+    return the report dict (report["violations"] empty on success;
+    every violation names the (scenario, seed) that reproduces it)."""
+    scenario.validate()
+    vclock = VirtualClock()
+    loop = new_sim_loop(vclock)
+    libs_clock.install(vclock)
+    restore_memo = install_verify_memo()
+    prev_force = _batch.set_force_host(scenario.verify_backend == "host")
+    rnd_state = random.getstate()
+    random.seed(derive_seed("global-rng", scenario.name, seed))
+    t0 = _wall.perf_counter()
+    report: dict = {
+        "scenario": scenario.name, "seed": seed, "nodes": scenario.nodes,
+        "virtual_duration_s": scenario.duration, "violations": [],
+        "fault_log": [], "heights_at_heal": None, "last_heal_t": 0.0,
+        # empty defaults so a deadlocked run (SimStallError fires
+        # before _collect) still yields a well-formed report and the
+        # sweep prints the repro pair instead of a KeyError traceback
+        "final_heights": [], "restarts": [], "net": {}, "chain": [],
+        "app_hashes": [], "evidence_committed": 0,
+    }
+    try:
+        loop.run_until_complete(_run(scenario, seed, report))
+    except SimStallError as e:
+        report["violations"].append(
+            f"deadlock: {e} [scenario={scenario.name} seed={seed}]")
+    finally:
+        try:
+            # settle stragglers (e.g. the receive routine's select
+            # futures, cancelled mid-wait) so close() is silent
+            pending = asyncio.all_tasks(loop)
+            for t in pending:
+                t.cancel()
+            if pending:
+                loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True))
+        except Exception:
+            pass
+        try:
+            loop.close()
+        finally:
+            random.setstate(rnd_state)
+            _batch.set_force_host(prev_force)
+            restore_memo()
+            libs_clock.uninstall()
+    report["wall_s"] = round(_wall.perf_counter() - t0, 3)
+    return report
+
+
+async def _run(sc: Scenario, seed: int, report: dict) -> None:
+    net = SimNetwork(seed=derive_seed("net", sc.name, seed),
+                     default_link=sc.link)
+    gdoc, pvs = sim_genesis(sc.nodes, seed, valset_size=sc.valset_size,
+                            power=sc.power, phantom_power=sc.phantom_power,
+                            chain_id=f"sim-{sc.name}-{seed}")
+    config = sim_consensus_config()
+    for k, val in sc.consensus.items():
+        setattr(config, k, val)
+    nodes = [SimNode(i, gdoc, pvs[i], net, seed=seed, config=config,
+                     gossip_sleep=sc.gossip_sleep)
+             for i in range(sc.nodes)]
+    # position k in the derivation: two same-kind specs on one node
+    # must draw INDEPENDENT streams, not replay each other's
+    byz = [(idx, make_byzantine(
+        spec, random.Random(derive_seed(
+            "byz", sc.name, seed, idx, k, spec.get("kind")))))
+        for k, (idx, spec) in enumerate(sc.byzantine_specs())]
+    for idx, b in byz:
+        b.install(nodes[idx])
+    edges = sc.edges(seed)
+    try:
+        for n in nodes:
+            await n.start()
+        for i, j in edges:
+            await nodes[i].dial(nodes[j])
+
+        drivers: list[tuple[str, asyncio.Task]] = []
+        for idx, b in byz:
+            d = b.driver(nodes[idx])
+            if d is not None:
+                drivers.append((f"byzantine[{idx}]",
+                                asyncio.ensure_future(d)))
+        if sc.tx_rate > 0:
+            drivers.append(("tx_loader",
+                            asyncio.ensure_future(_tx_loader(sc, nodes))))
+        drivers.append(("queue_sampler", asyncio.ensure_future(
+            _queue_sampler(sc, seed, report))))
+        if sc.probe is not None:
+            drivers.append(("probe", asyncio.ensure_future(
+                sc.probe(nodes, report))))
+        drivers.append(("fault_driver", asyncio.ensure_future(
+            _fault_driver(sc, seed, nodes, net, edges, report))))
+
+        await asyncio.sleep(sc.duration)
+
+        for _, d in drivers:
+            d.cancel()
+        results = await asyncio.gather(*(d for _, d in drivers),
+                                       return_exceptions=True)
+        # a crashed driver means the scenario did NOT run as specified
+        # (faults unapplied, load stopped early) — that must fail the
+        # run loudly, not let it report a clean pass
+        tag = f"[scenario={sc.name} seed={seed}]"
+        for (label, _), res in zip(drivers, results):
+            if isinstance(res, BaseException) and \
+                    not isinstance(res, asyncio.CancelledError):
+                report["violations"].append(
+                    f"driver_crash: {label}: {res!r} {tag}")
+    finally:
+        for n in nodes:
+            try:
+                await n.stop()
+            except Exception:
+                pass
+        net.close()
+
+    _collect(sc, seed, nodes, net, report)
+    _check_invariants(sc, seed, nodes, report)
+
+
+async def _tx_loader(sc: Scenario, nodes: list) -> None:
+    """Deterministic round-robin load: tx i lands in node i%n's
+    mempool at virtual time i/rate and commits whenever that node
+    proposes — app hashes then actually move, giving the oracle and
+    the determinism check real material."""
+    i = 0
+    interval = 1.0 / sc.tx_rate
+    while True:
+        node = nodes[i % len(nodes)]
+        if node.running:
+            node.mempool.add(b"sim-k%d=v%d" % (i, i))
+        i += 1
+        await asyncio.sleep(interval)
+
+
+async def _queue_sampler(sc: Scenario, seed: int, report: dict) -> None:
+    """bounded_queues invariant: sample every tracked queue once per
+    virtual second; depth beyond capacity is a violation (shedding is
+    fine — that is what the bound is FOR — overflow is not)."""
+    while True:
+        snap = CONTROLLER.evaluate()
+        for name, q in snap["queues"].items():
+            if q["capacity"] > 0 and q["depth"] > q["capacity"]:
+                report["violations"].append(
+                    f"bounded_queues: {name} depth {q['depth']} > "
+                    f"capacity {q['capacity']} "
+                    f"[scenario={sc.name} seed={seed}]")
+        await asyncio.sleep(1.0)
+
+
+async def _fault_driver(sc: Scenario, seed: int, nodes: list,
+                        net: SimNetwork, edges: list,
+                        report: dict) -> None:
+    loop = asyncio.get_running_loop()
+    events: list[tuple[float, int, str, Fault]] = []
+    for k, f in enumerate(sorted(sc.faults, key=lambda f: (f.at, f.kind))):
+        events.append((f.at, k, "begin", f))
+        events.append((f.end(), k, "end", f))
+    events.sort(key=lambda e: (e[0], e[1]))
+    last_end = max((i for i, e in enumerate(events) if e[2] == "end"),
+                   default=-1)
+    for ev_idx, (at, _k, phase, f) in enumerate(events):
+        delay = at - loop.time()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        report["fault_log"].append(
+            {"t": round(loop.time(), 3), "fault": f.kind, "phase": phase})
+        if f.kind == "partition":
+            if phase == "begin":
+                groups = [[sim_host(i) for i in g] for g in f.groups]
+                net.partition(groups)
+            else:
+                net.heal()
+        elif f.kind == "link_down":
+            net.set_link_down(sim_host(f.a), sim_host(f.b),
+                              down=(phase == "begin"))
+        elif f.kind == "churn":
+            node = nodes[f.node]
+            if phase == "begin":
+                await node.stop()
+            else:
+                await node.start()
+                for i, j in edges:  # re-dial this node's outbound edges
+                    if i == f.node:
+                        try:
+                            await node.dial(nodes[j])
+                        except Exception:
+                            pass  # peer partitioned/down: reconnect
+                            # machinery retries via persistent addrs
+        if ev_idx == last_end:
+            report["last_heal_t"] = round(loop.time(), 3)
+            report["heights_at_heal"] = [n.height() for n in nodes]
+
+
+# -- collection + invariants ------------------------------------------
+
+
+def _collect(sc: Scenario, seed: int, nodes: list, net: SimNetwork,
+             report: dict) -> None:
+    heights = [n.height() for n in nodes]
+    report["final_heights"] = heights
+    report["restarts"] = [n.restarts for n in nodes]
+    report["net"] = dict(net.stats)
+    best = max(range(len(nodes)), key=lambda i: heights[i])
+    chain = []
+    evidence = 0
+    for h in range(1, heights[best] + 1):
+        block = nodes[best].block_store.load_block(h)
+        if block is None:
+            chain.append(None)
+            continue
+        evidence += len(block.evidence.evidence)
+        chain.append({
+            "height": h,
+            "block_hash": block.hash().hex(),
+            "txs": len(block.data.txs),
+        })
+    # executed app hash for height h lives in header h+1
+    for h in range(1, heights[best]):
+        entry = chain[h - 1]
+        if entry is not None:
+            ah = nodes[best].app_hash_after(h)
+            entry["app_hash"] = ah.hex() if ah is not None else None
+    report["chain"] = chain
+    report["app_hashes"] = [
+        e.get("app_hash") for e in chain if e is not None]
+    report["evidence_committed"] = evidence
+
+
+def _oracle_app_hashes(node, upto: int) -> dict:
+    """Independent fold of the committed txs through the kvstore hash
+    rule (abci/kvstore.py: app_hash = big-endian count of applied kv
+    txs): catches execution divergence that unanimous agreement on a
+    WRONG hash would hide."""
+    size = 0
+    out: dict[int, bytes] = {}
+    for h in range(1, upto + 1):
+        block = node.block_store.load_block(h)
+        if block is None:
+            continue
+        for tx in block.data.txs:
+            if not tx.startswith(VALIDATOR_TX_PREFIX):
+                size += 1
+        out[h] = struct.pack(">Q", size)
+    return out
+
+
+def _check_invariants(sc: Scenario, seed: int, nodes: list,
+                      report: dict) -> None:
+    tag = f"[scenario={sc.name} seed={seed}]"
+    v = report["violations"]
+    heights = report["final_heights"]
+    max_h = max(heights)
+
+    # agreement: at every height, all nodes that committed a block
+    # committed the SAME block
+    for h in range(1, max_h + 1):
+        seen: dict[str, list[int]] = {}
+        for i, n in enumerate(nodes):
+            bh = n.block_hash(h)
+            if bh is not None:
+                seen.setdefault(bh.hex(), []).append(i)
+        if len(seen) > 1:
+            v.append(f"agreement: fork at height {h}: {seen} {tag}")
+
+    # app-hash oracle, per node (execution correctness, not just
+    # agreement): every executed height's app hash matches the fold
+    best = max(range(len(nodes)), key=lambda i: heights[i])
+    oracle = _oracle_app_hashes(nodes[best], max_h)
+    for i, n in enumerate(nodes):
+        for h in range(1, heights[i]):
+            got = n.app_hash_after(h)
+            want = oracle.get(h)
+            if got is not None and want is not None and got != want:
+                v.append(
+                    f"app_hash_oracle: node {i} height {h} app hash "
+                    f"{got.hex()} != oracle {want.hex()} {tag}")
+
+    # liveness floor
+    if max_h < sc.min_height:
+        v.append(f"liveness: max height {max_h} < min_height "
+                 f"{sc.min_height} {tag}")
+
+    # liveness after the last heal: the net as a whole must keep
+    # committing, and every node that was up at the end must have
+    # moved past its at-heal height
+    at_heal = report.get("heights_at_heal")
+    if at_heal is not None:
+        if max_h < max(at_heal) + 2:
+            v.append(
+                f"liveness_after_heal: max height {max_h} advanced "
+                f"< 2 past heal snapshot {max(at_heal)} {tag}")
+        for i, n in enumerate(nodes):
+            if n.running and heights[i] <= at_heal[i] and \
+                    heights[i] < max_h - 1:
+                v.append(
+                    f"liveness_after_heal: node {i} stuck at "
+                    f"{heights[i]} (heal snapshot {at_heal[i]}, "
+                    f"net at {max_h}) {tag}")
+
+
+# -- named scenarios --------------------------------------------------
+
+def _smoke_quorum() -> Scenario:
+    return Scenario(name="smoke_quorum", nodes=4, topology="full",
+                    duration=12.0, tx_rate=2.0, min_height=4)
+
+
+def _smoke_partition() -> Scenario:
+    return Scenario(
+        name="smoke_partition", nodes=5, topology="full", duration=20.0,
+        faults=(Fault(kind="partition", at=4.0, duration=5.0,
+                      groups=((0, 1, 2), (3, 4))),),
+        tx_rate=2.0, min_height=3)
+
+
+def _smoke_churn() -> Scenario:
+    return Scenario(
+        name="smoke_churn", nodes=4, topology="full", duration=20.0,
+        faults=(Fault(kind="churn", at=4.0, duration=4.0, node=3),),
+        tx_rate=2.0, min_height=3)
+
+
+def _smoke_equivocation() -> Scenario:
+    return Scenario(
+        name="smoke_equivocation", nodes=4, topology="full",
+        duration=16.0, byzantine={3: {"kind": "equivocation",
+                                      "heights": (2,)}},
+        tx_rate=2.0, min_height=4)
+
+
+def _smoke_garbage_flood() -> Scenario:
+    return Scenario(
+        name="smoke_garbage_flood", nodes=5, topology="full",
+        duration=18.0,
+        byzantine={4: {"kind": "garbage_flood", "rate": 30.0,
+                       "from_t": 2.0, "until_t": 12.0}},
+        tx_rate=2.0, min_height=3)
+
+
+def _trust_collapse() -> Scenario:
+    return Scenario(
+        name="trust_collapse", nodes=5, topology="full", duration=30.0,
+        byzantine={4: {"kind": "bad_signature_flood",
+                       "from_t": 2.0, "until_t": 12.0}},
+        tx_rate=2.0, min_height=3)
+
+
+def _wan_50() -> Scenario:
+    """The acceptance scenario: a 50-node WAN ring at PRODUCTION
+    cadence (10 s commit pace, 20±8 ms links) with a 40-second 25/25
+    partition, one churned node, an equivocating validator and a
+    garbage-flooding one — 5 minutes of large-net virtual time in
+    roughly half that wall clock, where a real 50-node net would need
+    the full 5 minutes plus 50 machines."""
+    return Scenario(
+        name="wan_50", nodes=50, topology="ring+3", duration=420.0,
+        link=LinkSpec(latency_ms=20.0, jitter_ms=8.0),
+        faults=(
+            Fault(kind="partition", at=50.0, duration=50.0,
+                  groups=(tuple(range(0, 25)), tuple(range(25, 50)))),
+            Fault(kind="churn", at=200.0, duration=30.0, node=7),
+        ),
+        byzantine={
+            3: {"kind": "equivocation", "heights": (3,)},
+            11: {"kind": "garbage_flood", "rate": 10.0,
+                 "from_t": 20.0, "until_t": 140.0},
+        },
+        consensus={"timeout_propose_ms": 3000, "timeout_prevote_ms": 1000,
+                   "timeout_precommit_ms": 1000,
+                   "timeout_commit_ms": 15_000},
+        tx_rate=1.0, min_height=10, gossip_sleep=0.25, tier="slow")
+
+
+def _valset_10k() -> Scenario:
+    """10k-validator valset structures (phantom low-power committee)
+    through proposer selection, commit assembly and verification at
+    every height. Wide-lane device launches are covered separately
+    (test_scale_10k); this pins the CONSENSUS structures at scale."""
+    return Scenario(
+        # keyed power must beat the phantom mass: 6 validators must
+        # hold > 2/3 of (6*power + 9994*1) total, i.e. power > 3332
+        name="valset_10k", nodes=6, valset_size=10_000, power=4000,
+        topology="full", duration=10.0, tx_rate=2.0, min_height=2,
+        tier="slow")
+
+
+def _timestamp_skew() -> Scenario:
+    return Scenario(
+        name="timestamp_skew", nodes=4, topology="full", duration=16.0,
+        byzantine={2: {"kind": "timestamp_skew", "skew_ms": 120_000}},
+        tx_rate=2.0, min_height=4)
+
+
+def _withhold_parts() -> Scenario:
+    return Scenario(
+        name="withhold_parts", nodes=4, topology="full", duration=20.0,
+        byzantine={1: {"kind": "withhold_parts",
+                       "heights": (2, 3)}},
+        tx_rate=2.0, min_height=3)
+
+
+def _double_propose() -> Scenario:
+    return Scenario(
+        name="double_propose", nodes=4, topology="full", duration=20.0,
+        byzantine={i: {"kind": "double_propose", "heights": (2,)}
+                   for i in range(4)},
+        tx_rate=2.0, min_height=3)
+
+
+SCENARIOS: dict = {}
+for _f in (_smoke_quorum, _smoke_partition, _smoke_churn,
+           _smoke_equivocation, _smoke_garbage_flood, _trust_collapse,
+           _timestamp_skew, _withhold_parts, _double_propose,
+           _wan_50, _valset_10k):
+    _sc = _f()
+    _sc.validate()
+    SCENARIOS[_sc.name] = _f

@@ -284,6 +284,7 @@ class ValidatorSet:
         # backend-dependent (expanded.max_keys: HBM budget on chips,
         # one build chunk on the CPU backend where tables buy nothing).
         if not (_EXPAND_MIN <= len(lanes) <= tv._MAX_BATCH
+                and not _batch.host_forced()
                 and _batch.device_available("ed25519")):
             return False
         try:

@@ -6,6 +6,8 @@ restart — the net keeps committing, nobody forks, everyone catches up."""
 import asyncio
 import os
 
+import pytest
+
 from tendermint_tpu.e2e import Manifest, Perturbation, Runner
 
 
@@ -33,8 +35,6 @@ duration = 1.5
     assert [pp.op for pp in m.perturbations] == ["kill", "pause"]
     assert m.perturbations[1].duration == 1.5
 
-    import pytest
-
     with pytest.raises(ValueError):
         Manifest.from_dict({"nodes": 2, "perturbations": [
             {"node": 5, "op": "kill", "at_height": 1}]})
@@ -43,6 +43,13 @@ duration = 1.5
             {"node": 0, "op": "nuke", "at_height": 1}]})
 
 
+# Every subprocess-net block below is slow-tier: each boots a real
+# multi-node net (~60-100 s healthy; a 60 s progress-gate stall where
+# `cryptography` is missing), and together they were eating ~9 min of
+# the 870 s tier-1 envelope (ROADMAP "Recent"). The manifest/config
+# validation fast paths above and the sim scenarios in test_sim.py
+# keep tier-1 coverage; run these with -m slow.
+@pytest.mark.slow
 def test_perturbations_full_run(tmp_path):
     """The VERDICT done-bar: a 4-node subprocess net survives kill -9
     (WAL recovery mid-consensus), pause, disconnect, and restart, under
@@ -75,6 +82,7 @@ def test_perturbations_full_run(tmp_path):
     assert n1_log.count(b"node node1 started") >= 2
 
 
+@pytest.mark.slow
 def test_maverick_in_subprocess_net(tmp_path):
     """A manifest-scheduled maverick (double-prevote) runs as a REAL
     subprocess node; the net keeps committing, does not fork, and the
@@ -130,6 +138,7 @@ def test_maverick_in_subprocess_net(tmp_path):
     asyncio.run(asyncio.wait_for(go(), timeout=1400))
 
 
+@pytest.mark.slow
 def test_late_statesync_node_joins(tmp_path):
     """A 4th validator held back at genesis joins the live net via
     STATE SYNC (snapshot discovery over p2p + light-client-verified
@@ -157,6 +166,7 @@ def test_late_statesync_node_joins(tmp_path):
         n3_log[-2000:].decode(errors="replace")
 
 
+@pytest.mark.slow
 def test_validator_update_schedule(tmp_path):
     """A scheduled validator-set change (reference manifest.go
     validator schedules): node3's power drops 10 -> 3 mid-run via a
@@ -197,6 +207,7 @@ def test_validator_update_manifest_validation():
                                  "bogus": 1}]})
 
 
+@pytest.mark.slow
 def test_out_of_process_abci_tcp(tmp_path):
     """The reference e2e matrix's ABCIProtocol dimension: each node
     talks varint-framed socket ABCI to its own external kvstore app
@@ -224,6 +235,7 @@ def test_out_of_process_abci_tcp(tmp_path):
         assert "serving KVStoreApp abci=socket" in log
 
 
+@pytest.mark.slow
 def test_out_of_process_abci_grpc(tmp_path):
     m = Manifest.from_dict({
         "chain_id": "abci-grpc-chain",
@@ -253,6 +265,7 @@ def test_abci_manifest_validation():
                 {"node": 0, "at_height": 2, "power": 5}]})
 
 
+@pytest.mark.slow
 def test_remote_signer_privval_net(tmp_path):
     """privval = "tcp" (reference PrivvalProtocol dimension): every
     validator key lives in a signer sidecar process dialing its node
@@ -298,6 +311,7 @@ def test_privval_manifest_validation():
                                 {"node": 0, "spec": "double-prevote@2"}]})
 
 
+@pytest.mark.slow
 def test_seed_bootstrap_net(tmp_path):
     """seed_bootstrap (reference e2e "seed" node role): validators'
     ONLY configured contact is a dedicated non-validator seed node;
@@ -327,8 +341,6 @@ def test_seed_bootstrap_net(tmp_path):
         assert 'persistent_peers = ""' in cfg
         assert "@127.0.0.1:28800" in cfg  # seeds = seed@base+500
 
-
-import pytest
 
 
 @pytest.mark.slow
@@ -439,6 +451,7 @@ def test_overload_perturbation(tmp_path):
     assert orep["cleared"], orep
 
 
+@pytest.mark.slow
 def test_disconnect_hard_severs_and_reconnects(tmp_path):
     """disconnect_hard drops a node's TCP connections BOTH ways (via
     the switch's sever() hook): peers observe connection loss — not a
