@@ -165,11 +165,18 @@ def worker():
     def metrics_delta(before):
         return tmetrics.delta(before, tmetrics.snapshot())
 
+    from tools.silicon_record import backend_label
+
     device = str(jax.devices()[0])
     common = {
         "metric": METRIC,
         "unit": "ms",
         "device": device,
+        # backend + n_devices on EVERY measured line: a CPU-fallback
+        # run must never be mistaken for a silicon number again, and
+        # mesh-sharded results are meaningless without the mesh size.
+        "backend": backend_label(device),
+        "n_devices": jax.device_count(),
         "cpu_baseline_us_per_sig": round(cpu_per_sig * 1e6, 1),
         "baseline_estimated": baseline_estimated,
     }

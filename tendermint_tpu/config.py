@@ -358,6 +358,35 @@ class SpeculationConfig:
             raise ValueError("negative speculation.flush_ms")
 
 
+@dataclass
+class MeshConfig:
+    """Multi-chip verify fabric (crypto/tpu/{verify,expanded,
+    resident}.py; this framework's addition): how the ('dp',) device
+    mesh is used by the production verify paths. Pure performance
+    knobs — verdicts are identical on any mesh shape."""
+
+    # Key-range sharding crossover for the expanded comb tables:
+    # valsets <= this many keys REPLICATE their tables on every chip
+    # (every gather chip-local, zero routing overhead); bigger sets
+    # row-shard by key range with lane->home-device routing, cutting
+    # per-chip HBM by the mesh size and lifting the valset cap to
+    # mesh_size x the single-chip budget. 0 = auto (the single-chip
+    # table budget — replicate while it fits, shard beyond). Values
+    # past the single-chip budget are effectively capped by it: a
+    # valset that cannot replicate within one chip shards regardless.
+    expanded_shard_crossover_keys: int = 0
+    # Split the speculation plane's ResidentArena into per-device
+    # shards when a mesh exists: steady-state splices upload only each
+    # chip's ~1/N of the ~105 B/lane deltas, and each shard carries
+    # its own known-answer sentinel (per-device breaker attribution).
+    arena_shards: bool = True
+
+    def validate_basic(self) -> None:
+        if self.expanded_shard_crossover_keys < 0:
+            raise ValueError(
+                "negative mesh.expanded_shard_crossover_keys")
+
+
 def fast_consensus_config() -> ConsensusConfig:
     """Short timeouts for in-process tests (reference: the 10ms
     timeout-commit test config, config/config.go:867-875)."""
@@ -424,6 +453,7 @@ class Config:
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     speculation: SpeculationConfig = field(
         default_factory=SpeculationConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig
@@ -440,6 +470,7 @@ class Config:
         self.fastsync.validate_basic()
         self.consensus.validate_basic()
         self.speculation.validate_basic()
+        self.mesh.validate_basic()
         self.tx_index.validate_basic()
         self.chaos.validate_basic()
 
@@ -451,7 +482,7 @@ class Config:
         lines = []
         for section_name in ("base", "rpc", "p2p", "mempool", "light",
                              "statesync", "fastsync", "consensus",
-                             "speculation", "tx_index",
+                             "speculation", "mesh", "tx_index",
                              "instrumentation", "chaos"):
             section = getattr(self, section_name)
             lines.append(f"[{section_name}]")

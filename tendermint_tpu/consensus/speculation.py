@@ -447,12 +447,20 @@ class SpeculationPlane:
             if not out[0]:
                 # sentinel mismatch: wrong-verdict device — open the
                 # breaker and re-verify on host rather than storing
-                # garbage verdicts for later serving
-                cbatch.mark_device_failed("ed25519")
+                # garbage verdicts for later serving. A sharded arena
+                # attributes the failure to the specific chip(s) whose
+                # per-shard sentinel broke (the per-device breaker
+                # attribution the mesh fabric adds): the breaker stays
+                # backend-wide, the log names the chip.
+                failed = getattr(arena, "failed_shards", lambda: [])()
+                detail = ", ".join(
+                    f"shard {i} ({dev})" for i, dev in failed) or None
+                cbatch.mark_device_failed("ed25519", device=detail)
                 logger.error(
                     "speculative launch (%d lanes) failed its "
-                    "known-answer sentinel; breaker open %.1fs, "
+                    "known-answer sentinel%s; breaker open %.1fs, "
                     "re-verifying on host", n,
+                    f" on {detail}" if detail else "",
                     cbatch.breaker("ed25519").cooldown_remaining())
                 met.launches.inc(backend="host_recheck")
                 tpu_metrics().host_fallbacks.inc()
@@ -461,7 +469,7 @@ class SpeculationPlane:
 
     def _ensure_arena(self, entry: _HeightSpec):
         from ..crypto.tpu.resident import GROUPS, PRE_W, SUF_W, \
-            ResidentArena
+            make_arena
 
         if len(entry.valset.validators) + 1 > self.arena_lanes:
             return None
@@ -473,7 +481,10 @@ class SpeculationPlane:
             # the arena kernel is ed25519-only; mixed sets go host-side
             return None
         if self._arena is None:
-            self._arena = ResidentArena(self.arena_lanes)
+            # per-device shards when a mesh exists: steady-state
+            # splices upload only each chip's ~1/N of the deltas, and
+            # every shard carries its own known-answer sentinel
+            self._arena = make_arena(self.arena_lanes)
         if len(entry.valset.validators) + 1 > self._arena.capacity:
             return None
         if self._arena_keys_hash != entry.valset_hash:

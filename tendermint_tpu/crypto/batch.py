@@ -265,8 +265,18 @@ def device_available(backend: str | None = None) -> bool:
     return all(b.available() for b in _BREAKERS.values())
 
 
-def mark_device_failed(backend: str = "ed25519") -> None:
+def mark_device_failed(backend: str = "ed25519",
+                       device: str | None = None) -> None:
+    """Open the backend's breaker. `device` attributes the failure to
+    a specific mesh chip (per-shard sentinel mismatches from
+    MeshResidentArena launches) — the breaker itself stays
+    per-backend (one wrong-verdict chip poisons any launch that
+    shards lanes onto it, so the whole mesh must cool down), but the
+    operator sees WHICH chip to pull from the log."""
     _BREAKERS[backend].record_failure()
+    if device:
+        logger.error("device failure attributed to mesh device(s) %s "
+                     "(%s backend)", device, backend)
     from ..libs.metrics import crypto_metrics
 
     crypto_metrics().device_failures.inc()

@@ -58,6 +58,70 @@ _ROW = 128     # table row width: 4 coords * NLIMB limbs, padded.
 # general kernel is used instead.
 MIN_EXPAND = 128
 
+# -- key-range sharding crossover ------------------------------------
+#
+# Below the crossover the comb tables REPLICATE over the ('dp',) mesh
+# (every gather chip-local, zero routing overhead — the right trade
+# while the table fits one chip's HBM); above it they row-shard by
+# KEY RANGE: device d holds the table rows of keys [d*K, (d+1)*K), and
+# every launch routes lanes to their key's home device at pack time so
+# the flat row-gather stays chip-local — per-chip HBM drops N× and the
+# valset cap lifts to N× the single-chip budget. Configured via the
+# [mesh] config section (node._build) or TM_TPU_SHARD_CROSSOVER.
+_SHARD_CROSSOVER: int | None = None
+
+
+def set_shard_crossover(n: int | None) -> None:
+    """Valsets <= n replicate tables per chip; above n they key-range
+    shard. None/0 restores auto (the single-chip table budget)."""
+    global _SHARD_CROSSOVER
+    _SHARD_CROSSOVER = int(n) if n else None
+
+
+# CPU-backend policy cap for replicated tables: one DEFAULT build
+# chunk's worth of keys. A deliberate constant rather than the live
+# ExpandedKeys.BUILD_CHUNK attribute: tests shrink BUILD_CHUNK to
+# force chunked builds, and the chunking knob must not silently
+# re-route the build REGIME (replicated vs sharded vs refused).
+_CPU_MAX_KEYS = 2048
+
+
+def _single_chip_max_keys() -> int:
+    """Largest valset whose REPLICATED tables fit one device.
+
+    Accelerators: HBM budget — ~318 KB/key, 3.3 GB at 10k keys on a
+    16 GB chip, ~40k the practical ceiling. CPU backend (tests / e2e
+    nets / degraded nodes): one default build chunk — tables buy
+    nothing there (no host->device wire to save), so big builds are
+    pure cost."""
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        return _CPU_MAX_KEYS
+    return 40_000
+
+
+def shard_crossover_keys() -> int:
+    import os
+
+    if _SHARD_CROSSOVER is not None:
+        return _SHARD_CROSSOVER
+    env = os.environ.get("TM_TPU_SHARD_CROSSOVER")
+    if env:
+        try:
+            val = int(env)
+        except ValueError:
+            # env is the lenient surface (config is the strict one):
+            # a malformed value must not start raising mid-verify
+            from .. import batch as _batch
+
+            _batch.logger.warning(
+                "ignoring malformed TM_TPU_SHARD_CROSSOVER=%r", env)
+            val = 0
+        if val:  # 0 means auto here too, like the config knob
+            return val
+    return _single_chip_max_keys()
+
 
 @functools.cache
 def _builder():
@@ -229,6 +293,27 @@ def _xkernel(wpi: int = WINDOWS_PER_ITER):
 
 
 @functools.cache
+def _xkernel_sharded(wpi: int = WINDOWS_PER_ITER):
+    """Key-range-sharded front-end: every per-lane array and the comb
+    table carry a leading device axis (sharded P('dp')); vmapping the
+    UNCHANGED verify body over it makes each device run the core on
+    its local (lanes, key-range) block — local indices address local
+    table rows, so the flat row-gather never crosses chips (btab, the
+    fixed-base comb, replicates: every device needs every window)."""
+    import jax
+
+    core = _xcore(wpi)
+
+    @jax.jit
+    def kernel(idx, akeys, sb, msg, nblocks, s_ok, key_ok, atab, btab):
+        return jax.vmap(
+            core, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None))(
+            idx, akeys, sb, msg, nblocks, s_ok, key_ok, atab, btab)
+
+    return kernel
+
+
+@functools.cache
 def assemble_core():
     """The structured message-assembly body as a traceable function:
     (pre, pre_len, suf, suf_len, patch, split, patch_len, group,
@@ -304,6 +389,52 @@ def _skernel(wpi: int = WINDOWS_PER_ITER):
     return skernel
 
 
+@functools.cache
+def _skernel_sharded(wpi: int = WINDOWS_PER_ITER):
+    """_skernel over key-range-sharded tables: per-lane arrays carry a
+    leading device axis; the commit-wide templates (and btab)
+    replicate — every device assembles its own lanes' sign bytes from
+    the same templates, then verifies against its local key range."""
+    import jax
+
+    core = _xcore(wpi)
+    assemble = assemble_core()
+
+    @functools.partial(jax.jit, static_argnames=("width",))
+    def skernel(idx, akeys, sb, s_ok, key_ok, atab, btab,
+                pre, pre_len, suf, suf_len, patch, split, patch_len,
+                group, *, width):
+        def one(idx, akeys, sb, s_ok, key_ok, atab, patch, split,
+                patch_len, group):
+            msg, nblocks = assemble(pre, pre_len, suf, suf_len, patch,
+                                    split, patch_len, group, width)
+            return core(idx, akeys, sb, msg, nblocks, s_ok, key_ok,
+                        atab, btab)
+
+        return jax.vmap(one)(idx, akeys, sb, s_ok, key_ok, atab,
+                             patch, split, patch_len, group)
+
+    return skernel
+
+
+class _RoutedVerdicts:
+    """Device verdicts of a lane-routed sharded launch, presented in
+    the caller's original lane order (quacks like the device array
+    _traced_verify expects: block_until_ready + np.asarray)."""
+
+    def __init__(self, dev, slot: np.ndarray):
+        self._dev = dev
+        self._slot = slot
+
+    def block_until_ready(self):
+        self._dev.block_until_ready()
+        return self
+
+    def __array__(self, dtype=None, copy=None):
+        out = np.asarray(self._dev).reshape(-1)[self._slot]
+        return out.astype(dtype) if dtype is not None else out
+
+
 class ExpandedKeys:
     """Device-resident comb tables for a fixed list of ed25519 pubkeys."""
 
@@ -323,44 +454,37 @@ class ExpandedKeys:
         assert all(len(p) == 32 for p in self.pubkeys)
         a_raw = np.frombuffer(b"".join(self.pubkeys), np.uint8).reshape(-1, 32)
         v = len(self.pubkeys)
-        if v <= self.BUILD_CHUNK:
-            tv.count_compile("table_builder", (v,))
-            tables, ok = _builder()(jnp.asarray(a_raw))
-        else:
-            # Pad to a chunk multiple (one compiled shape), build each
-            # chunk, concatenate on device. Padding keys are never
-            # addressed: verify() asserts idx < len(pubkeys).
-            chunk = self.BUILD_CHUNK
-            vp = (v + chunk - 1) // chunk * chunk
-            padded = np.zeros((vp, 32), np.uint8)
-            padded[:v] = a_raw
-            t_parts, ok_parts = [], []
-            tv.count_compile("table_builder", (chunk,))
-            for s in range(0, vp, chunk):
-                t, o = _builder()(jnp.asarray(padded[s:s + chunk]))
-                t_parts.append(t)
-                ok_parts.append(o)
-            tables = jnp.concatenate(t_parts, axis=0)
-            if vp != v:
-                # drop the padding keys' rows (up to chunk-1 keys ×
-                # ~318 KB each would otherwise sit in HBM — and be
-                # replicated per mesh chip — for the cache lifetime)
-                tables = tables[: v * _WINDOWS * _ENTRIES]
-            ok = jnp.concatenate(ok_parts)[:v]
-        # Multi-chip: REPLICATE the tables over the ('dp',) mesh and
+        self.sharded = False
+        self.n_shards = 1
+        self.keys_per_shard = v
+        self.mesh = tv._mesh()
+        # Shard above the crossover — or above the single-chip budget
+        # regardless of the crossover: an operator raising the
+        # crossover past the budget must degrade to sharding, not to a
+        # per-commit ValueError that churns the breaker.
+        if self.mesh is not None and (
+                v > shard_crossover_keys()
+                or v > _single_chip_max_keys()):
+            self._build_sharded(a_raw)
+            return
+        if v > _single_chip_max_keys():
+            raise ValueError(
+                f"{v}-key expanded build exceeds the single-chip table "
+                f"budget ({_single_chip_max_keys()} keys) and no mesh "
+                "is available for key-range sharding")
+        tables, ok = self._build_tables(a_raw)
+        # Small sets: REPLICATE the tables over the ('dp',) mesh and
         # shard lanes at launch (same scheme as verify_batch). Lane
-        # digits address arbitrary table rows, so a row-sharded table
-        # would turn the flat gather into an all-gather of the full
-        # multi-GB buffer every launch; replication keeps every gather
-        # chip-local at 69 * 512 B/lane. HBM cost is the table size per
-        # chip (~318 KB/key, 3.3 GB at 10k keys — within a v5e's 16 GB;
-        # beyond ~40k keys switch to key-range sharding + lane routing).
+        # digits address arbitrary table rows, so replication keeps
+        # every gather chip-local at 69 * 512 B/lane with zero routing
+        # overhead; HBM cost is the full table per chip (~318 KB/key).
+        # Above the shard crossover, _build_sharded row-shards by KEY
+        # RANGE instead and launches route lanes to home devices.
         akeys = jnp.asarray(a_raw)
-        mesh = tv._mesh()
-        if mesh is not None:
+        if self.mesh is not None:
             import jax
 
-            _, _, repl_s = tv._shardings(mesh)
+            _, _, repl_s = tv._shardings(self.mesh)
             tables = jax.device_put(tables, repl_s)
             ok = jax.device_put(ok, repl_s)
             akeys = jax.device_put(akeys, repl_s)
@@ -369,6 +493,103 @@ class ExpandedKeys:
         # Pubkey bytes device-resident beside the tables: verify
         # launches send (N,) indices instead of (N, 32) pubkey rows.
         self.akeys = akeys
+
+    def _build_tables(self, a_raw: np.ndarray, device=None):
+        """Chunked comb-table build: (V, 32) pubkey rows ->
+        ((V*69*9, 128) rows, (V,) ok). Builder launches run on the
+        default device (BUILD_CHUNK bounds their transients); with
+        `device` set, each chunk's rows move to that device as they
+        land and the concatenation happens THERE — the sharded build's
+        per-range blocks must not pile up on the default device."""
+        import jax.numpy as jnp
+
+        def park(t):
+            if device is None:
+                return t
+            import jax
+
+            return jax.device_put(t, device)
+
+        v = a_raw.shape[0]
+        if v <= self.BUILD_CHUNK:
+            tv.count_compile("table_builder", (v,))
+            t, o = _builder()(jnp.asarray(a_raw))
+            return park(t), o
+        # Pad to a chunk multiple (one compiled shape), build each
+        # chunk, concatenate on device. Padding keys are never
+        # addressed: verify() asserts idx < len(pubkeys).
+        chunk = self.BUILD_CHUNK
+        vp = (v + chunk - 1) // chunk * chunk
+        padded = np.zeros((vp, 32), np.uint8)
+        padded[:v] = a_raw
+        t_parts, ok_parts = [], []
+        tv.count_compile("table_builder", (chunk,))
+        for s in range(0, vp, chunk):
+            t, o = _builder()(jnp.asarray(padded[s:s + chunk]))
+            t_parts.append(park(t))
+            ok_parts.append(o)
+        tables = jnp.concatenate(t_parts, axis=0)
+        if vp != v:
+            # drop the padding keys' rows (up to chunk-1 keys ×
+            # ~318 KB each would otherwise sit in HBM — and be
+            # replicated per mesh chip — for the cache lifetime)
+            tables = tables[: v * _WINDOWS * _ENTRIES]
+        ok = jnp.concatenate(ok_parts)[:v]
+        return tables, ok
+
+    def _build_sharded(self, a_raw: np.ndarray) -> None:
+        """Key-range-sharded build: pad the valset to D*K keys, build
+        each K-key range chunk by chunk (builder launches on the
+        default device with BUILD_CHUNK-bounded transients, each
+        chunk's rows parked on the range's HOME device as they land),
+        and assemble the per-device blocks into ONE global
+        (D, K*69*9, 128) array sharded P('dp') on axis 0 — no chip
+        ever holds more than its own range. Lifts the valset cap to
+        D × the single-chip budget and cuts per-chip HBM D×; launches
+        route lanes to home devices (_route) so the flat row-gather
+        stays chip-local."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.mesh
+        v = a_raw.shape[0]
+        devs = list(mesh.devices.flat)
+        d_n = len(devs)
+        k = -(-v // d_n)
+        vp = k * d_n
+        padded = np.zeros((vp, 32), np.uint8)
+        padded[:v] = a_raw
+        rows_per_key = _WINDOWS * _ENTRIES
+        sh = NamedSharding(mesh, P("dp"))
+        parts = []
+        ok_np = np.zeros((d_n, k), bool)
+        for d in range(d_n):
+            # chunks park on the HOME device as they land, so the
+            # default device's transient stays one BUILD_CHUNK deep
+            # regardless of shard or mesh size
+            t, o = self._build_tables(padded[d * k:(d + 1) * k],
+                                      device=devs[d])
+            parts.append(t.reshape(1, k * rows_per_key, _ROW))
+            ok_np[d] = np.asarray(o)
+        # Padding keys never verify (idx is asserted < len(pubkeys)
+        # and pad LANES are discarded by the slot scatter), but keep
+        # their ok flags False for hygiene.
+        ok_np.reshape(-1)[v:] = False
+        self.tables = jax.make_array_from_single_device_arrays(
+            (d_n, k * rows_per_key, _ROW), sh, parts)
+        self.key_ok = jax.device_put(jnp.asarray(ok_np), sh)
+        self.akeys = jax.device_put(
+            jnp.asarray(padded.reshape(d_n, k, 32)), sh)
+        self.sharded = True
+        self.n_shards = d_n
+        self.keys_per_shard = k
+        try:
+            from ...libs.metrics import tpu_metrics
+
+            tpu_metrics().table_shard_bytes.set(int(parts[0].nbytes))
+        except Exception:  # pragma: no cover - metrics never fatal
+            pass
 
     def __len__(self) -> int:
         return len(self.pubkeys)
@@ -421,7 +642,10 @@ class ExpandedKeys:
         n = len(indices)
         assert len(msgs) == n
         idx = self._check_idx(indices, len(sigs))
-        bucket = self._bucket(n)
+        # Key-range-sharded tables bucket PER DEVICE inside _route —
+        # pre-padding here would home every pad lane (idx 0) on device
+        # 0 and inflate the common per-device bucket for all shards.
+        bucket = n if self.sharded else self._bucket(n)
         pad = bucket - n
         sig_raw, well_formed = self._sig_rows(sigs, pad)
         if pad:
@@ -431,17 +655,27 @@ class ExpandedKeys:
         return idx, packed, well_formed
 
     def _shard_args(self, idx, fields, repl_keys=()):
-        """Shared mesh dispatch for both launch forms: lane-shard the
-        per-lane arrays over the ('dp',) mesh when one exists (tables,
-        comb constants, and any `repl_keys` fields replicated; verdict
-        gather is the only cross-chip traffic)."""
+        """Shared mesh dispatch for both launch forms (replicated
+        tables): lane-shard the per-lane arrays over the ('dp',) mesh
+        when one exists (tables, comb constants, and any `repl_keys`
+        fields replicated; verdict gather is the only cross-chip
+        traffic). Odd buckets pad up to a device multiple — the pad
+        lanes carry zero signatures (s_ok False) and are discarded by
+        the caller's [:n] slice — instead of forfeiting the mesh."""
         btab = tv.b_comb_tables()
         mesh = tv._mesh()
         bucket = idx.shape[0]
-        if (mesh is not None and bucket >= tv._SHARD_MIN
-                and bucket % mesh.devices.size == 0):
+        if mesh is not None and bucket >= tv._SHARD_MIN:
             import jax
 
+            pad = tv.mesh_lane_pad(bucket, mesh) - bucket
+            if pad:
+                idx = np.concatenate([idx, np.zeros(pad, np.int32)])
+                fields = {
+                    k: v if k in repl_keys else np.pad(
+                        v, ((0, pad),) + ((0, 0),) * (v.ndim - 1))
+                    for k, v in fields.items()
+                }
             row_s, vec_s, repl_s = tv._shardings(mesh)
             idx = jax.device_put(idx, vec_s)
             fields = {
@@ -451,13 +685,87 @@ class ExpandedKeys:
                 for k, v in fields.items()
             }
             btab = jax.device_put(btab, repl_s)
+            tv.count_shard_lanes(mesh, bucket + pad)
         return idx, fields, btab
+
+    def _route(self, idx, per_lane: dict):
+        """Lane → home-device routing at pack time (key-range-sharded
+        tables): stable-sort lanes by their key's home device, pad
+        every device to a common per-device lane bucket, and rebase
+        indices into the device's local key range. Returns the routed
+        (D, n_local[, ...]) device arrays plus the flat slot map that
+        restores original lane order on readback. Pad lanes carry
+        local index 0 and zero signatures (s_ok False) — inert, and
+        dropped by the slot scatter anyway. n_local is the LARGEST
+        shard's bucketed count: balanced batches (commit lanes are
+        distinct validators) run ~N/D per chip, while a pathological
+        all-one-range batch pads every chip to the full batch — skewed
+        ad-hoc index sets belong below the shard crossover."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        d_n, k = self.n_shards, self.keys_per_shard
+        bucket = idx.shape[0]
+        home = idx // k
+        order = np.argsort(home, kind="stable")
+        counts = np.bincount(home, minlength=d_n)
+        n_local = self._bucket(max(int(counts.max()), 1))
+        local_idx = np.zeros((d_n, n_local), np.int32)
+        routed = {
+            name: np.zeros((d_n, n_local) + a.shape[1:], a.dtype)
+            for name, a in per_lane.items()
+        }
+        slot = np.zeros(bucket, np.int64)
+        off = 0
+        for d in range(d_n):
+            sel = order[off:off + counts[d]]
+            local_idx[d, :counts[d]] = idx[sel] - d * k
+            for name, a in per_lane.items():
+                routed[name][d, :counts[d]] = a[sel]
+            slot[sel] = d * n_local + np.arange(counts[d])
+            off += counts[d]
+        # padding included — every device executes n_local lanes —
+        # the same semantics as the other dispatch sites
+        tv.count_shard_lanes(self.mesh, n_local * d_n)
+        try:
+            from ...libs.metrics import tpu_metrics
+
+            # occupancy against the lanes the mesh actually executes
+            # (d_n * n_local), so routing skew shows up instead of
+            # reading ~D× too healthy
+            tpu_metrics().batch_occupancy.observe(
+                bucket / (n_local * d_n))
+        except Exception:  # pragma: no cover - metrics never fatal
+            pass
+        sh = NamedSharding(self.mesh, P("dp"))
+        repl_s = NamedSharding(self.mesh, P())
+        lidx = jax.device_put(local_idx, sh)
+        routed = {name: jax.device_put(a, sh)
+                  for name, a in routed.items()}
+        btab = jax.device_put(tv.b_comb_tables(), repl_s)
+        return lidx, routed, btab, repl_s, slot
 
     def _launch(self, idx, packed):
         """Device side of verify: one kernel launch over packed lanes."""
+        if self.sharded:
+            lidx, routed, btab, _repl_s, slot = self._route(idx, packed)
+            tv.count_compile(
+                "expanded_sharded",
+                (self.n_shards, lidx.shape[1], routed["msg"].shape[2]))
+            out = _xkernel_sharded(WINDOWS_PER_ITER)(
+                idx=lidx,
+                akeys=self.akeys,
+                key_ok=self.key_ok,
+                atab=self.tables,
+                btab=btab,
+                **routed,
+            )
+            return _RoutedVerdicts(out, slot)
+        idx, packed, btab = self._shard_args(idx, packed)
+        # count at the POST-padding shape: mesh_lane_pad may merge two
+        # requested buckets into one compiled shape
         tv.count_compile("expanded",
                          (idx.shape[0], packed["msg"].shape[1]))
-        idx, packed, btab = self._shard_args(idx, packed)
         return _xkernel(WINDOWS_PER_ITER)(
             idx=idx,
             akeys=self.akeys,
@@ -493,7 +801,10 @@ class ExpandedKeys:
         verdict array."""
         from ...libs.metrics import tpu_metrics
 
-        tpu_metrics().batch_occupancy.observe(n / self._bucket(n))
+        if not self.sharded:
+            # the sharded path observes occupancy in _route, against
+            # the per-device routed bucket it actually executes
+            tpu_metrics().batch_occupancy.observe(n / self._bucket(n))
         t = tracing.TRACER
         with t.span(tracing.CRYPTO_VERIFY, lanes=n, backend=backend):
             with t.span(tracing.CRYPTO_PACK, lanes=n):
@@ -546,7 +857,8 @@ class ExpandedKeys:
         kp = self._S_GROUPS
         if k > kp or pw > 128 or sw > 64:
             raise ValueError("templates too large for structured path")
-        bucket = self._bucket(n)
+        # sharded tables: no pre-pad — _route buckets per device
+        bucket = n if self.sharded else self._bucket(n)
         pad = bucket - n
         sig_raw, well_formed = self._sig_rows(sigs, pad)
 
@@ -569,10 +881,33 @@ class ExpandedKeys:
         )
         return idx, fields, well_formed, width
 
+    _S_REPL = ("pre", "pre_len", "suf", "suf_len")
+
     def _launch_structured(self, idx, fields, width):
-        tv.count_compile("structured", (idx.shape[0], width))
+        if self.sharded:
+            import jax
+
+            per = {k: v for k, v in fields.items()
+                   if k not in self._S_REPL}
+            lidx, routed, btab, repl_s, slot = self._route(idx, per)
+            tv.count_compile("structured_sharded",
+                             (self.n_shards, lidx.shape[1], width))
+            repl = {k: jax.device_put(fields[k], repl_s)
+                    for k in self._S_REPL}
+            out = _skernel_sharded(WINDOWS_PER_ITER)(
+                idx=lidx,
+                akeys=self.akeys,
+                key_ok=self.key_ok,
+                atab=self.tables,
+                btab=btab,
+                width=width,
+                **routed,
+                **repl,
+            )
+            return _RoutedVerdicts(out, slot)
         idx, fields, btab = self._shard_args(
-            idx, fields, repl_keys=("pre", "pre_len", "suf", "suf_len"))
+            idx, fields, repl_keys=self._S_REPL)
+        tv.count_compile("structured", (idx.shape[0], width))
         return _skernel(WINDOWS_PER_ITER)(
             idx=idx,
             akeys=self.akeys,
@@ -616,23 +951,25 @@ _CACHE_LOCK = threading.Lock()
 _BUILDS: dict[bytes, threading.Event] = {}
 
 
-@functools.cache
 def max_keys() -> int:
     """Largest valset the expanded tables serve on this backend.
 
-    Accelerators: HBM budget — ~318 KB/key, 3.3 GB at 10k keys on a
-    16 GB chip; beyond ~40k switch to key-range sharding (not yet
-    needed: MaxVotesCount caps commits at 10k validators). CPU
-    backend (tests / e2e nets / degraded nodes): the tables replicate
-    per virtual mesh device inside ONE host RAM and there is no
-    host->device wire to save, so big builds are pure cost — cap at
-    one build chunk. Callers fall back to the general batch path
-    above the cap (ValidatorSet._use_expanded)."""
+    Accelerators: the single-chip HBM budget (~318 KB/key, ~40k keys
+    on a 16 GB chip) times the mesh size — above the shard crossover
+    the tables row-shard by key range across devices, so an N-chip
+    mesh serves N × the single-chip cap. CPU backend (tests / e2e
+    nets / degraded nodes): one build chunk regardless of the virtual
+    mesh — the shards live inside ONE host RAM and there is no
+    host->device wire to save, so big builds are pure cost. Callers
+    fall back to the general batch path above the cap
+    (ValidatorSet._use_expanded)."""
     import jax
 
+    base = _single_chip_max_keys()
     if jax.devices()[0].platform == "cpu":
-        return ExpandedKeys.BUILD_CHUNK
-    return 40_000
+        return base  # virtual shards share one host RAM: no lift
+    mesh = tv._mesh()
+    return base * mesh.devices.size if mesh is not None else base
 
 
 def get_expanded(pubkeys: list[bytes]) -> ExpandedKeys:

@@ -95,6 +95,69 @@ def _clear_fn():
 
 
 @functools.cache
+def _mesh_splice_fn():
+    """_splice_fn over a leading device axis: ONE donated jit call
+    scatters every shard's (k_local, ...) delta block into its
+    resident slice — all-axis-0-sharded operands keep the scatters
+    chip-local, and donation still aliases outputs onto the sharded
+    input buffers."""
+    import jax
+
+    def splice(sb, s_ok, patch, split, patch_len, group, active,
+               pos, d_sb, d_sok, d_patch, d_split, d_plen, d_group):
+        def upd(b, p, v):
+            return b.at[p].set(v)
+
+        return (
+            jax.vmap(upd)(sb, pos, d_sb),
+            jax.vmap(upd)(s_ok, pos, d_sok),
+            jax.vmap(upd)(patch, pos, d_patch),
+            jax.vmap(upd)(split, pos, d_split),
+            jax.vmap(upd)(patch_len, pos, d_plen),
+            jax.vmap(upd)(group, pos, d_group),
+            jax.vmap(lambda a, p: a.at[p].set(True))(active, pos),
+        )
+
+    return jax.jit(splice, donate_argnums=tuple(range(7)))
+
+
+@functools.cache
+def _mesh_clear_fn():
+    """Donated deactivate-all (every shard's sentinel stays active)."""
+    import jax
+    import jax.numpy as jnp
+
+    def clear(active):
+        return jnp.zeros_like(active).at[:, 0].set(True)
+
+    return jax.jit(clear, donate_argnums=(0,))
+
+
+@functools.cache
+def _mesh_arena_kernel(width: int):
+    """_arena_kernel vmapped over the leading device axis: each shard
+    verifies its resident block against its own sentinel, all under
+    ONE jit (one trace + one compile; templates and btab replicate)."""
+    import jax
+
+    assemble = assemble_core()
+    core = tv.general_core()
+
+    @jax.jit
+    def kernel(ab, sb, s_ok, active, pre, pre_len, suf, suf_len,
+               patch, split, patch_len, group, btab):
+        def one(ab, sb, s_ok, active, patch, split, patch_len, group):
+            msg, nblocks = assemble(pre, pre_len, suf, suf_len, patch,
+                                    split, patch_len, group, width)
+            return core(ab, sb, msg, nblocks, s_ok, btab) & active
+
+        return jax.vmap(one)(ab, sb, s_ok, active, patch, split,
+                             patch_len, group)
+
+    return kernel
+
+
+@functools.cache
 def _arena_kernel(width: int):
     """Structured assembly (expanded.assemble_core) in front of the
     general verify body (verify.general_core) over per-lane resident
@@ -289,3 +352,308 @@ class ResidentArena:
                 return db.unsafe_buffer_pointer()
             except Exception:
                 return None
+
+
+class MeshResidentArena:
+    """Per-device arena shards over the ('dp',) verify mesh, as ONE
+    jitted program.
+
+    Every resident array carries a leading device axis — (D, per, ...)
+    sharded P('dp') — so device d physically holds only its shard's
+    rows, yet splice and launch are each a SINGLE donated jit call
+    (one trace + one compile total; a per-shard-objects design would
+    pay D separate executables, since jit caches per device).
+
+    Global app slots (1..capacity-1, the SpeculationPlane's
+    validator_index+1 convention) round-robin across shards — app lane
+    i lives on shard i % D at local slot i // D + 1 — so a commit's
+    arriving precommits spread evenly and each device's steady-state
+    splice receives only its ~1/D share of the ~105 B/lane deltas
+    (delta rows route per shard, padded to a common per-shard bucket
+    with idempotent sentinel-row writes).
+
+    Every shard keeps its OWN known-answer sentinel at local slot 0,
+    so a wrong-verdict chip is attributed individually (launch()
+    records per-shard results in `sentinel_ok`) instead of the
+    whole-mesh "sentinel failed somewhere" signal a single shared
+    sentinel would give. The aggregate verdict array's slot 0 reads
+    True only when EVERY shard's sentinel verified — callers keeping
+    the single-arena `out[0]` contract stay exactly as safe."""
+
+    def __init__(self, lanes: int, width: int = WIDTH, mesh=None):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .. import batch as cbatch
+
+        mesh = mesh if mesh is not None else tv._mesh()
+        assert mesh is not None, "MeshResidentArena needs a device mesh"
+        self.mesh = mesh
+        self.devices = list(mesh.devices.flat)
+        d_n = len(self.devices)
+        self.n_shards = d_n
+        # per-shard capacity: the app-lane share + the shard sentinel,
+        # bucketed like the single arena so kernel shapes stay stable
+        per = ExpandedKeys._bucket(
+            max(-(-(max(lanes, 2) - 1) // d_n) + 1, 2))
+        self.shard_capacity = per
+        self.capacity = 1 + d_n * (per - 1)
+        self.width = width
+        self.sentinel_ok: list[bool] | None = None
+        self._sh = NamedSharding(mesh, P("dp"))
+
+        spub, smsg, ssig = cbatch._ed_probe_triple()
+        assert len(smsg) <= PRE_W
+        ab = np.zeros((d_n, per, 32), np.uint8)
+        sb = np.zeros((d_n, per, 64), np.uint8)
+        ab[:, 0] = np.frombuffer(spub, np.uint8)
+        sb[:, 0] = np.frombuffer(ssig, np.uint8)
+        # sentinel-row signature constant: splice() pads a shard's
+        # delta block by re-writing its sentinel row with these exact
+        # bytes, so padding rows are idempotent
+        self._sent_sb = sb[0, 0].copy()
+        s_ok = tv.s_range_ok(sb.reshape(-1, 64)).reshape(d_n, per)
+        active = np.zeros((d_n, per), bool)
+        active[:, 0] = True
+
+        def put(x):
+            return jax.device_put(jnp.asarray(x), self._sh)
+
+        self._ab = put(ab)
+        self._sb = put(sb)
+        self._s_ok = put(s_ok)
+        self._patch = put(np.zeros((d_n, per, PATCH_W), np.uint8))
+        self._split = put(np.zeros((d_n, per), np.int32))
+        self._patch_len = put(np.zeros((d_n, per), np.int32))
+        self._group = put(np.zeros((d_n, per), np.int32))
+        self._active = put(active)
+        # host-side template staging (small; replicated per launch)
+        self.pre = np.zeros((GROUPS, PRE_W), np.uint8)
+        self.pre_len = np.zeros(GROUPS, np.int32)
+        self.suf = np.zeros((GROUPS, SUF_W), np.uint8)
+        self.suf_len = np.zeros(GROUPS, np.int32)
+        self.pre[0, :len(smsg)] = np.frombuffer(smsg, np.uint8)
+        self.pre_len[0] = len(smsg)
+        self.reupload_bytes = 0
+        self._shard_reupload = [0] * d_n
+        try:
+            from ...libs.metrics import speculation_metrics
+
+            speculation_metrics().arena_bytes.set(self.arena_bytes())
+        except Exception:  # pragma: no cover - metrics never fatal
+            pass
+
+    # -- sizes / metrics ----------------------------------------------
+
+    def arena_bytes(self) -> int:
+        # array metadata only — never np.asarray (the CPU-backend view
+        # would pin the buffer and defeat donation; see ResidentArena)
+        return sum(int(a.nbytes) for a in (
+            self._ab, self._sb, self._s_ok, self._patch, self._split,
+            self._patch_len, self._group, self._active))
+
+    def _count_reupload(self, per_device: int) -> None:
+        """`per_device` bytes went to EACH device this operation."""
+        self.reupload_bytes += per_device * self.n_shards
+        for d in range(self.n_shards):
+            self._shard_reupload[d] += per_device
+        try:
+            from ...libs.metrics import speculation_metrics
+
+            speculation_metrics().reupload_bytes.inc(
+                per_device * self.n_shards)
+        except Exception:  # pragma: no cover - metrics never fatal
+            pass
+
+    def shard_reupload_bytes(self) -> list[int]:
+        """Per-device upload accounting — what the acceptance bound
+        (single-device bytes / D + per-shard template overhead) and
+        `tools/crypto_bench.py --mesh` measure."""
+        return list(self._shard_reupload)
+
+    # Slot routing convention (install_keys and splice inline the
+    # vectorized form): global app slot s -> shard (s-1) % D, local
+    # slot (s-1) // D + 1.
+
+    # -- slow-path installs (valset / height changes) ------------------
+
+    def install_keys(self, pubkeys: list[bytes], start: int = 1) -> None:
+        """Upload pubkey rows for global app slots start.. — once per
+        validator-set change, routed to each key's home shard."""
+        import jax
+        import jax.numpy as jnp
+
+        assert start >= 1, "slot 0 is the sentinel"
+        assert start + len(pubkeys) <= self.capacity
+        assert all(len(p) == 32 for p in pubkeys)
+        ab = np.asarray(self._ab).copy()
+        i = np.arange(start - 1, start - 1 + len(pubkeys))
+        ab[i % self.n_shards, i // self.n_shards + 1] = np.frombuffer(
+            b"".join(pubkeys), np.uint8).reshape(-1, 32)
+        self._ab = jax.device_put(jnp.asarray(ab), self._sh)
+
+    def set_template(self, group: int, pre: bytes, suf: bytes) -> None:
+        """Stage a (pre, suf) template row (group 0 is the sentinels');
+        templates replicate to every shard per launch."""
+        assert 1 <= group < GROUPS
+        assert len(pre) <= PRE_W and len(suf) <= SUF_W
+        self.pre[group] = 0
+        self.suf[group] = 0
+        self.pre[group, :len(pre)] = np.frombuffer(pre, np.uint8)
+        self.suf[group, :len(suf)] = np.frombuffer(suf, np.uint8)
+        self.pre_len[group] = len(pre)
+        self.suf_len[group] = len(suf)
+
+    def deactivate_all(self) -> None:
+        """New height: every lane but the per-shard sentinels goes
+        inactive; buffers stay resident for the next splices."""
+        self._active = _mesh_clear_fn()(self._active)
+
+    # -- the steady-state hot path ------------------------------------
+
+    def splice(self, slots, sig_rows: np.ndarray, patch: np.ndarray,
+               split: np.ndarray, patch_len: np.ndarray,
+               group: np.ndarray) -> None:
+        """Route each arriving lane to its home shard and ship ONE
+        donated scatter of (D, k_local, ...) delta blocks — per DEVICE
+        upload is ~1/D of the single-arena splice. Rows padding a
+        shard's block re-write its sentinel row with the sentinel's
+        own constants (idempotent), so padding can never corrupt a
+        real lane."""
+        k = len(slots)
+        if k == 0:
+            return
+        d_n = self.n_shards
+        sig_rows = np.asarray(sig_rows, np.uint8).reshape(k, 64)
+        d_sok = tv.s_range_ok(sig_rows)
+        patch = np.asarray(patch, np.uint8).reshape(k, PATCH_W)
+        split = np.asarray(split, np.int32).reshape(k)
+        patch_len = np.asarray(patch_len, np.int32).reshape(k)
+        group = np.asarray(group, np.int32).reshape(k)
+        # vectorized slot -> (shard, local) routing (the round-robin
+        # convention above): ~10k Python iterations per full-commit
+        # splice otherwise
+        i = np.asarray(slots, np.int64) - 1
+        assert i.size and i.min() >= 0 and i.max() < self.capacity - 1, \
+            "slot 0 is the sentinel; slots must fit the arena"
+        home = (i % d_n).astype(np.int64)
+        local = (i // d_n + 1).astype(np.int32)
+        order = np.argsort(home, kind="stable")
+        counts = np.bincount(home, minlength=d_n)
+        k_max = int(counts.max())
+        bucket = _MIN_DELTA
+        while bucket < k_max:
+            bucket <<= 1
+        bucket = min(bucket, self.shard_capacity)
+        if bucket < k_max:  # capacity-sized delta (full re-patch)
+            bucket = k_max
+        pos = np.zeros((d_n, bucket), np.int32)
+        v_sb = np.tile(self._sent_sb, (d_n, bucket, 1))
+        v_sok = np.ones((d_n, bucket), bool)
+        v_patch = np.zeros((d_n, bucket, PATCH_W), np.uint8)
+        v_split = np.zeros((d_n, bucket), np.int32)
+        v_plen = np.zeros((d_n, bucket), np.int32)
+        v_group = np.zeros((d_n, bucket), np.int32)
+        off = 0
+        for d in range(d_n):
+            m = int(counts[d])
+            if not m:
+                continue
+            sel = order[off:off + m]
+            off += m
+            pos[d, :m] = local[sel]
+            v_sb[d, :m] = sig_rows[sel]
+            v_sok[d, :m] = d_sok[sel]
+            v_patch[d, :m] = patch[sel]
+            v_split[d, :m] = split[sel]
+            v_plen[d, :m] = patch_len[sel]
+            v_group[d, :m] = group[sel]
+        per_dev = sum(int(a.nbytes) for a in (
+            pos, v_sb, v_sok, v_patch, v_split, v_plen,
+            v_group)) // d_n
+        self._count_reupload(per_dev)
+        sh = self._sh
+        import jax
+
+        args = [jax.device_put(a, sh) for a in (
+            pos, v_sb, v_sok, v_patch, v_split, v_plen, v_group)]
+        (self._sb, self._s_ok, self._patch, self._split,
+         self._patch_len, self._group, self._active) = \
+            _mesh_splice_fn()(
+                self._sb, self._s_ok, self._patch, self._split,
+                self._patch_len, self._group, self._active, *args)
+
+    def launch(self) -> np.ndarray:
+        """ONE vmapped kernel over every shard's resident block (the
+        per-device programs run concurrently under the single jit
+        dispatch). Returns (capacity,) verdicts in GLOBAL slot order;
+        `sentinel_ok` holds each shard's known-answer result for
+        per-device attribution. Slot 0 of the returned array is the
+        conjunction of every shard sentinel."""
+        tv.count_compile("resident_mesh",
+                         (self.n_shards, self.shard_capacity,
+                          self.width))
+        self._count_reupload(
+            int(self.pre.nbytes + self.suf.nbytes
+                + self.pre_len.nbytes + self.suf_len.nbytes))
+        out = _mesh_arena_kernel(self.width)(
+            self._ab, self._sb, self._s_ok, self._active,
+            self.pre, self.pre_len, self.suf, self.suf_len,
+            self._patch, self._split, self._patch_len, self._group,
+            tv.b_comb_tables())
+        o = np.asarray(out)  # (D, per)
+        d_n = self.n_shards
+        self.sentinel_ok = [bool(o[d, 0]) for d in range(d_n)]
+        verd = np.zeros(self.capacity, bool)
+        verd[0] = all(self.sentinel_ok)
+        for d in range(d_n):
+            verd[1 + d::d_n] = o[d, 1:]
+        try:
+            from ...libs.metrics import tpu_metrics
+
+            tmet = tpu_metrics()
+            for d in range(d_n):
+                tmet.shard_lanes.inc(self.shard_capacity,
+                                     device=str(d))
+        except Exception:  # pragma: no cover - metrics never fatal
+            pass
+        return verd
+
+    def failed_shards(self) -> list[tuple[int, str]]:
+        """(shard index, device) of every sentinel that failed on the
+        last launch — the per-device breaker attribution detail."""
+        if self.sentinel_ok is None:
+            return []
+        return [(i, str(self.devices[i]))
+                for i, ok in enumerate(self.sentinel_ok) if not ok]
+
+    def buffer_pointer(self, name: str = "sb", shard: int = 0):
+        """unsafe_buffer_pointer of one shard's slice of a resident
+        array (donation round-trip pinning, like ResidentArena's)."""
+        arr = getattr(self, f"_{name}")
+        try:
+            return arr.addressable_data(shard).unsafe_buffer_pointer()
+        except Exception:
+            return None
+
+
+# Per-device arena shards on/off (the [mesh] config section's
+# arena_shards knob, wired by node._build; default on — a mesh that
+# exists should be used).
+_ARENA_SHARDS = True
+
+
+def set_arena_shards(on: bool) -> None:
+    global _ARENA_SHARDS
+    _ARENA_SHARDS = bool(on)
+
+
+def make_arena(lanes: int, width: int = WIDTH):
+    """The speculation plane's arena factory: per-device shards when a
+    mesh exists (and [mesh] arena_shards is on), the classic
+    single-device arena otherwise."""
+    mesh = tv._mesh()
+    if _ARENA_SHARDS and mesh is not None:
+        return MeshResidentArena(lanes, width, mesh=mesh)
+    return ResidentArena(lanes, width)

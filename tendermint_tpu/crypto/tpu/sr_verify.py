@@ -165,6 +165,13 @@ def verify_batch_sr(pubs, msgs, sigs, ctx: bytes = b"",
             bucket <<= 1
     else:
         bucket = (n + 1023) // 1024 * 1024
+    mesh = None if cpu else tv._mesh()
+    shard = mesh is not None and bucket >= tv._SHARD_MIN
+    if shard:
+        # Odd buckets pad up to a device multiple (inert zero lanes)
+        # instead of forfeiting the mesh — same contract as the
+        # ed25519 paths (verify.mesh_lane_pad).
+        bucket = tv.mesh_lane_pad(bucket, mesh)
     pad = bucket - n
     if pad:
         a_raw = np.pad(a_raw, ((0, pad), (0, 0)))
@@ -176,7 +183,6 @@ def verify_batch_sr(pubs, msgs, sigs, ctx: bytes = b"",
         r_pre = np.pad(r_pre, (0, pad))
 
     btab = tv.b_comb_tables()[:_WINDOWS]
-    mesh = None if cpu else tv._mesh()
     args = dict(ab=a_raw, rb=r_raw, kdig=kdig, sdig=sdig,
                 a_pre=a_pre, r_pre=r_pre, s_ok=s_ok)
     if cpu:
@@ -185,8 +191,7 @@ def verify_batch_sr(pubs, msgs, sigs, ctx: bytes = b"",
         with jax.default_device(jax.local_devices(backend="cpu")[0]):
             out = _kernel()(btab=btab, **args)
         return np.asarray(out)[:n] & well_formed
-    if (mesh is not None and bucket >= tv._SHARD_MIN
-            and bucket % mesh.devices.size == 0):
+    if shard:
         import jax
 
         row_s, vec_s, repl_s = tv._shardings(mesh)
@@ -201,5 +206,6 @@ def verify_batch_sr(pubs, msgs, sigs, ctx: bytes = b"",
             else:
                 args[key] = jax.device_put(v, row_s)
         btab = jax.device_put(btab, repl_s)
+        tv.count_shard_lanes(mesh, bucket)
     out = _kernel()(btab=btab, **args)
     return np.asarray(out)[:n] & well_formed

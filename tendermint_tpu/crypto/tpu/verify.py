@@ -267,6 +267,12 @@ def _mesh():
     import jax
 
     devs = jax.devices()
+    try:
+        from ...libs.metrics import tpu_metrics
+
+        tpu_metrics().mesh_devices.set(max(len(devs), 1))
+    except Exception:  # pragma: no cover - metrics never fatal
+        pass
     if len(devs) <= 1:
         return None
     import numpy as np_
@@ -274,6 +280,31 @@ def _mesh():
     from jax.sharding import Mesh
 
     return Mesh(np_.array(devs), ("dp",))
+
+
+def mesh_lane_pad(bucket: int, mesh) -> int:
+    """Round a lane bucket up to the next device multiple so an odd
+    bucket rides the mesh on padded lanes instead of forfeiting it
+    (pre-mesh-fabric behavior: any `bucket % devices != 0` silently
+    fell back to a single device)."""
+    d = int(mesh.devices.size)
+    return -(-bucket // d) * d
+
+
+def count_shard_lanes(mesh, bucket: int) -> None:
+    """tpu_shard_lanes_total{device}: lanes (padding included — the
+    device executes them either way) dispatched per mesh device by an
+    evenly lane-sharded launch."""
+    try:
+        from ...libs.metrics import tpu_metrics
+
+        tmet = tpu_metrics()
+        d = int(mesh.devices.size)
+        per = bucket // d
+        for i in range(d):
+            tmet.shard_lanes.inc(per, device=str(i))
+    except Exception:  # pragma: no cover - metrics never fatal
+        pass
 
 
 def _shardings(mesh):
@@ -393,6 +424,13 @@ def _launch_chunk(pubs, msgs, sigs, bucket: int):
     triple so they cannot affect real lanes."""
     n = len(pubs)
     t = tracing.TRACER
+    mesh = _mesh()
+    shard = mesh is not None and bucket >= _SHARD_MIN
+    if shard:
+        # Odd buckets pad up to a device multiple (the extra lanes are
+        # the same inert dummy triple) instead of dropping to a single
+        # device — a 10,001-lane batch must not forfeit the mesh.
+        bucket = mesh_lane_pad(bucket, mesh)
     with t.span(tracing.CRYPTO_PACK, lanes=bucket):
         if bucket > n:
             dp, dm, ds = _dummy_triple()
@@ -404,9 +442,7 @@ def _launch_chunk(pubs, msgs, sigs, bucket: int):
     count_compile("general", (bucket, packed["msg"].shape[1]))
     with t.span(tracing.CRYPTO_DISPATCH, lanes=bucket):
         btab = b_comb_tables()
-        mesh = _mesh()
-        if (mesh is not None and bucket >= _SHARD_MIN
-                and bucket % mesh.devices.size == 0):
+        if shard:
             import jax
 
             row_s, vec_s, repl_s = _shardings(mesh)
@@ -415,4 +451,5 @@ def _launch_chunk(pubs, msgs, sigs, bucket: int):
                 for k, v in packed.items()
             }
             btab = jax.device_put(btab, repl_s)
+            count_shard_lanes(mesh, bucket)
         return _kernel()(btab=btab, **packed)

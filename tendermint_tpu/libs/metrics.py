@@ -679,6 +679,18 @@ class TPUMetrics:
             "expanded_build_seconds",
             "Wall time building expanded comb tables for a valset.", "tpu",
             buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120)))
+    mesh_devices: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "mesh_devices",
+        "Devices in the ('dp',) verify mesh (1 = single-device).",
+        "tpu"))
+    shard_lanes: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "shard_lanes_total",
+        "Signature lanes dispatched to each mesh device by sharded "
+        "verify launches, by device.", "tpu"))
+    table_shard_bytes: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "table_shard_bytes",
+        "Per-device bytes of the newest key-range-sharded expanded "
+        "comb table (0 until a sharded build runs).", "tpu"))
 
 
 @dataclass

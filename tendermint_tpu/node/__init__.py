@@ -174,6 +174,26 @@ class Node(Service):
 
             failpoints.install_spec(cfg.chaos.failpoints,
                                     source="config", strict=True)
+        # [mesh] multi-chip verify-fabric knobs, applied before any
+        # subsystem can build expanded tables or a speculation arena.
+        # The section defaults equal the crypto modules' built-in
+        # defaults, so stock nodes skip the (import-bearing) wiring —
+        # UNLESS the modules are already loaded in this process, where
+        # the settings must be applied unconditionally so a default-
+        # config node never inherits a previous in-process node's
+        # non-default knobs (multi-node test harnesses).
+        import sys as _sys
+
+        if (cfg.mesh.expanded_shard_crossover_keys
+                or not cfg.mesh.arena_shards
+                or "tendermint_tpu.crypto.tpu.expanded" in _sys.modules
+                or "tendermint_tpu.crypto.tpu.resident" in _sys.modules):
+            from ..crypto.tpu import expanded as _expanded
+            from ..crypto.tpu import resident as _resident
+
+            _expanded.set_shard_crossover(
+                cfg.mesh.expanded_shard_crossover_keys or None)
+            _resident.set_arena_shards(cfg.mesh.arena_shards)
         self.block_store = BlockStore(_db(cfg, "blockstore",
                                           self.in_memory))
         self.state_store = Store(_db(cfg, "state", self.in_memory))

@@ -6,6 +6,13 @@ batch paths, printed as one table. Run on CPU for sanity or on the
 real chip for numbers:
 
     python tools/crypto_bench.py [--cpu] [--batch N]
+
+`--mesh N` runs the multi-chip fabric A/B instead (over an N-device
+mesh — forced-host CPU devices unless GRAFT_REAL_DEVICES=1):
+replicated vs key-range-sharded expanded tables, fresh-transfer vs
+resident-shard relaunches, with per-launch per-device byte accounting,
+emitted as one MULTICHIP-style JSON line (backend + n_devices stamped
+so a CPU run can never pass as silicon).
 """
 
 import hashlib
@@ -91,7 +98,164 @@ def _resident_ab(batch: int):
     ]
 
 
+def _commit_lanes(n, n_keys):
+    """Commit-shaped lanes over a fixed valset: (pubs, idx, msgs,
+    sigs) with real canonical vote sign bytes."""
+    import numpy as np
+
+    from tendermint_tpu.crypto import ed25519_ref as ref
+    from tendermint_tpu.types import canonical
+    from tendermint_tpu.types.block import BlockID, PartSetHeader
+    from tendermint_tpu.types.vote import VoteType
+
+    seeds = [hashlib.sha256(b"mesh%d" % i).digest()
+             for i in range(n_keys)]
+    pubs = [ref.public_key_from_seed(s) for s in seeds]
+    bid = BlockID(b"\xab" * 32, PartSetHeader(4, b"\xcd" * 32))
+    base_ts = 1_753_928_000_000_000_000
+    idx = np.asarray([i % n_keys for i in range(n)], np.int32)
+    msgs = [canonical.vote_sign_bytes(
+        "bench-chain", int(VoteType.PRECOMMIT), 123456, 0, bid,
+        base_ts + i * 1_000_003) for i in range(n)]
+    sigs = [ref.sign(seeds[idx[i]], m) for i, m in enumerate(msgs)]
+    return pubs, idx, msgs, sigs
+
+
+def _mesh_ab(batch: int) -> int:
+    """The multi-chip fabric A/B: replicated vs key-range-sharded
+    expanded tables and fresh-transfer vs per-device resident-shard
+    relaunches, with per-launch per-device byte accounting. Prints a
+    MULTICHIP-style JSON line as the final output."""
+    import json
+
+    import numpy as np
+
+    import jax
+
+    from tendermint_tpu.crypto.tpu import expanded as ex
+    from tendermint_tpu.crypto.tpu import verify as tv
+    from tendermint_tpu.crypto.tpu.resident import (
+        MeshResidentArena, ResidentArena,
+    )
+    from tendermint_tpu.types import sign_batch as sbm
+
+    from tools.silicon_record import backend_label
+
+    device = str(jax.devices()[0])
+    line = {
+        "metric": "multichip_crypto_bench",
+        "backend": backend_label(device),
+        "n_devices": jax.device_count(),
+        "device": device,
+        "ok": False,
+    }
+    mesh = tv._mesh()
+    if mesh is None:
+        line["error"] = "no multi-device mesh (need --mesh N >= 2)"
+        print(json.dumps(line), flush=True)
+        return 2
+    d_n = int(mesh.devices.size)
+    n = batch
+    n_keys = max(d_n * 16, min(n, 256))
+    pubs, idx, msgs, sigs = _commit_lanes(n, n_keys)
+    idx_l = list(idx)
+    line.update(lanes=n, keys=n_keys)
+
+    # -- A: replicated tables (the pre-fabric production path) --
+    ex.set_shard_crossover(None)
+    try:
+        repl = ex.ExpandedKeys(pubs)
+        assert not repl.sharded
+        want = repl.verify(idx_l, msgs, sigs)
+        assert bool(np.asarray(want).all())
+        t_repl = timeit(lambda: repl.verify(idx_l, msgs, sigs), 3)
+        line["replicated_p50_ms"] = round(t_repl * 1e3, 3)
+        line["replicated_table_bytes_per_device"] = int(
+            repl.tables.nbytes)
+
+        # -- B: key-range-sharded tables + lane routing --
+        ex.set_shard_crossover(1)
+        shd = ex.ExpandedKeys(pubs)
+        assert shd.sharded and shd.n_shards == d_n
+        got = shd.verify(idx_l, msgs, sigs)
+        assert (np.asarray(got) == np.asarray(want)).all(), \
+            "sharded verdicts diverged from replicated"
+        t_shd = timeit(lambda: shd.verify(idx_l, msgs, sigs), 3)
+        line["sharded_p50_ms"] = round(t_shd * 1e3, 3)
+        line["sharded_table_bytes_per_device"] = int(
+            shd.tables.nbytes) // d_n
+        line["sharded_lanes_per_device"] = [
+            int(c) for c in np.bincount(idx // shd.keys_per_shard,
+                                        minlength=d_n)]
+    finally:
+        ex.set_shard_crossover(None)
+
+    # -- C: fresh-transfer vs per-device resident-shard relaunch --
+    delta = max(1, min(64, n // 16))
+    fresh_bytes = n * (32 + 64) + sum(len(m) for m in msgs)
+    arena = MeshResidentArena(n + 1, mesh=mesh)
+    single = ResidentArena(n + 1)
+    from tendermint_tpu.types import canonical
+    from tendermint_tpu.types.block import BlockID, PartSetHeader
+    from tendermint_tpu.types.vote import VoteType
+
+    bid = BlockID(b"\xab" * 32, PartSetHeader(4, b"\xcd" * 32))
+    pre, suf = canonical.vote_sign_parts(
+        "bench-chain", int(VoteType.PRECOMMIT), 123456, 0, bid)
+    base_ts = 1_753_928_000_000_000_000
+    ts = np.asarray([base_ts + i * 1_000_003 for i in range(n)],
+                    np.int64)
+    group = np.ones(n, np.int32)
+    for a in (arena, single):
+        a.set_template(1, pre, suf)
+    patch, split, patch_len = sbm._build_patches(
+        arena.pre_len.astype(np.int64), arena.suf_len, group, ts)
+    sig_rows = np.frombuffer(b"".join(sigs), np.uint8).reshape(n, 64)
+    slots = list(range(1, n + 1))
+    for a in (arena, single):
+        a.splice(slots, sig_rows, patch, split, patch_len, group)
+    lo_single = single.reupload_bytes
+    single.splice(slots[:delta], sig_rows[:delta], patch[:delta],
+                  split[:delta], patch_len[:delta], group[:delta])
+    single_delta = single.reupload_bytes - lo_single
+    lo_shards = arena.shard_reupload_bytes()
+    arena.splice(slots[:delta], sig_rows[:delta], patch[:delta],
+                 split[:delta], patch_len[:delta], group[:delta])
+    per_dev = [hi - lo for hi, lo in
+               zip(arena.shard_reupload_bytes(), lo_shards)]
+    line["resident"] = {
+        "fresh_bytes_per_launch": fresh_bytes,
+        "delta_lanes": delta,
+        "single_device_delta_bytes": int(single_delta),
+        "shard_delta_bytes_per_device": [int(b) for b in per_dev],
+        "max_shard_delta_bytes": int(max(per_dev)),
+    }
+    line["ok"] = True
+    if "--record" in sys.argv:
+        from tools import silicon_record
+
+        line["recorded"] = silicon_record.record_if_tpu(
+            "crypto_bench_mesh", device, dict(line))
+    print(json.dumps(line), flush=True)
+    return 0
+
+
 def main():
+    mesh_n = 0
+    if "--mesh" in sys.argv:
+        # Env must land before the first jax import: force an N-device
+        # host-platform mesh unless the caller wants real chips.
+        mesh_n = int(sys.argv[sys.argv.index("--mesh") + 1])
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={mesh_n}"
+            ).strip()
+        if not os.environ.get("GRAFT_REAL_DEVICES"):
+            from tendermint_tpu.libs.cpuforce import force_cpu_backend
+
+            force_cpu_backend()
     if "--cpu" in sys.argv:
         from tendermint_tpu.libs.cpuforce import force_cpu_backend
 
@@ -100,6 +264,8 @@ def main():
     for i, a in enumerate(sys.argv):
         if a == "--batch":
             batch = int(sys.argv[i + 1])
+    if mesh_n:
+        sys.exit(_mesh_ab(batch))
 
     rows = []
 
@@ -175,7 +341,8 @@ def main():
     if "--record" in sys.argv:
         from tools import silicon_record
 
-        payload = {"device": device, "batch": batch}
+        payload = {"device": device, "batch": batch,
+                   "n_devices": jax.device_count()}
         payload.update(
             {name: round(secs * 1e6, 2) for name, secs in rows})
         print("recorded ->", silicon_record.record_if_tpu(

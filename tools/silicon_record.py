@@ -34,6 +34,14 @@ def _now() -> str:
     return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
 
 
+def backend_label(device) -> str:
+    """The one backend classification every measurement tool stamps
+    (bench.py, crypto_bench, the multichip dryrun) and the gate
+    record_if_tpu enforces — so a CPU-fallback number can never drift
+    into passing as silicon in one tool but not another."""
+    return "tpu" if "tpu" in str(device).lower() else "cpu-fallback"
+
+
 def load() -> dict:
     try:
         with open(RECORD_PATH) as f:
@@ -68,9 +76,14 @@ def record(step: str, payload: dict) -> str:
 
 def record_if_tpu(step: str, device: str, payload: dict) -> str | None:
     """Gate shared by every measurement tool: persist only real-chip
-    results (CPU smoke runs must not pollute the record)."""
-    if "tpu" not in str(device).lower():
+    results (CPU smoke runs must not pollute the record). Every
+    persisted entry is stamped `backend: tpu` so a record row can
+    never be mistaken for a CPU-fallback number even when the caller
+    forgot the field."""
+    if backend_label(device) != "tpu":
         return None
+    payload = dict(payload)
+    payload.setdefault("backend", "tpu")
     return record(step, payload)
 
 
