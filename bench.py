@@ -451,6 +451,18 @@ def worker():
             line_s["resident_reupload_bytes"] = (
                 arena.reupload_bytes if arena is not None else 0)
             line_s["spec_stage_breakdown"] = stage_breakdown()
+            # Height-forensics rollup on the record: full consensus-
+            # kind breakdown of the measured window + trace-ring
+            # health, so a truncated ring can never pass silently as
+            # a complete stage attribution (tools/forensics.py is the
+            # cross-node reader of the same data).
+            line_s["trace_rollup"] = TRACER.stage_rollup(
+                prefix="consensus.")
+            line_s["trace_ring"] = {
+                "capacity": TRACER.capacity,
+                "len": len(TRACER),
+                "dropped": TRACER.dropped,
+            }
             _emit(line_s)
         except Exception as e:  # the headline number must survive
             line_s["spec_error"] = repr(e)[:300]

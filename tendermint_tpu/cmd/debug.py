@@ -184,10 +184,15 @@ def cmd_debug_trace(args) -> int:
             args.pprof_laddr,
             f"/debug/trace/rollup?seconds={args.seconds}"
             if args.seconds else "/debug/trace/rollup"))
-        for kind, row in rollup.items():
+        for kind, row in rollup.get("stages", {}).items():
             print(f"  {kind:<24} n={row['count']:<6} "
                   f"p50={row['p50_ms']}ms p95={row['p95_ms']}ms "
                   f"p99={row['p99_ms']}ms")
+        dropped = rollup.get("spans_dropped", 0)
+        if dropped:
+            print(f"  WARNING: {dropped} spans evicted from the ring "
+                  f"(capacity {rollup.get('capacity')}) — the timeline "
+                  "above is a suffix, not the whole story")
     except Exception as e:
         print(f"warning: rollup unavailable: {e!r}")
     return 0

@@ -37,6 +37,10 @@ class NewValidBlockMessage:
 @dataclass
 class ProposalMessage:
     proposal: Proposal
+    # Optional cross-node trace context (libs/tracing.py origin tag):
+    # opaque on the wire, skipped by decoders that predate it. Rides
+    # the three block-lifecycle messages only (Proposal/BlockPart/Vote).
+    origin: bytes | None = None
 
 
 @dataclass
@@ -51,11 +55,13 @@ class BlockPartMessage:
     height: int
     round: int
     part: Part
+    origin: bytes | None = None
 
 
 @dataclass
 class VoteMessage:
     vote: Vote
+    origin: bytes | None = None
 
 
 @dataclass
@@ -148,6 +154,8 @@ def encode_consensus_msg(msg) -> bytes:
         w.bool(5, msg.is_commit)
     elif isinstance(msg, ProposalMessage):
         w.message(1, msg.proposal.to_proto())
+        if msg.origin:
+            w.bytes(15, msg.origin)
     elif isinstance(msg, ProposalPOLMessage):
         w.varint(1, msg.height)
         w.varint(2, msg.proposal_pol_round, skip_zero=False)
@@ -156,8 +164,12 @@ def encode_consensus_msg(msg) -> bytes:
         w.varint(1, msg.height)
         w.varint(2, msg.round, skip_zero=False)
         w.message(3, _part_writer(msg.part))
+        if msg.origin:
+            w.bytes(15, msg.origin)
     elif isinstance(msg, VoteMessage):
         w.message(1, msg.vote.to_proto())
+        if msg.origin:
+            w.bytes(15, msg.origin)
     elif isinstance(msg, HasVoteMessage):
         w.varint(1, msg.height)
         w.varint(2, msg.round, skip_zero=False)
@@ -230,15 +242,18 @@ def decode_consensus_msg(data: bytes):
         return cls(height, round_, psh, bits, is_commit)
     if cls is ProposalMessage:
         prop = None
+        origin = None
         while not r.at_end():
             f, wt = r.field()
             if f == 1:
                 prop = Proposal.from_bytes(r.bytes())
+            elif f == 15:
+                origin = r.bytes()
             else:
                 r.skip(wt)
         if prop is None:
             raise ValueError("ProposalMessage without a proposal")
-        return cls(prop)
+        return cls(prop, origin=origin)
     if cls is ProposalPOLMessage:
         height = pol_round = 0
         bits = BitArray(0)
@@ -256,6 +271,7 @@ def decode_consensus_msg(data: bytes):
     if cls is BlockPartMessage:
         height = round_ = 0
         part = None
+        origin = None
         while not r.at_end():
             f, wt = r.field()
             if f == 1:
@@ -264,22 +280,27 @@ def decode_consensus_msg(data: bytes):
                 round_ = r.varint()
             elif f == 3:
                 part = _read_part(r.bytes())
+            elif f == 15:
+                origin = r.bytes()
             else:
                 r.skip(wt)
         if part is None:
             raise ValueError("BlockPartMessage without a part")
-        return cls(height, round_, part)
+        return cls(height, round_, part, origin=origin)
     if cls is VoteMessage:
         vote = None
+        origin = None
         while not r.at_end():
             f, wt = r.field()
             if f == 1:
                 vote = Vote.from_bytes(r.bytes())
+            elif f == 15:
+                origin = r.bytes()
             else:
                 r.skip(wt)
         if vote is None:
             raise ValueError("VoteMessage without a vote")
-        return cls(vote)
+        return cls(vote, origin=origin)
     if cls is HasVoteMessage:
         kw = dict(height=0, round=0, type=0, index=0)
         names = {1: "height", 2: "round", 3: "type", 4: "index"}

@@ -18,7 +18,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
-from ..libs import clock
+from ..libs import clock, tracing
 from ..libs.bits import BitArray
 from ..p2p.conn.connection import ChannelDescriptor
 from ..p2p.switch import Reactor
@@ -266,6 +266,39 @@ class ConsensusReactor(Reactor):
         # knowing about the p2p layer.
         cs.reporter_fn = lambda: getattr(self.switch, "reporter", None)
 
+    # -- origin stamping (height forensics) --
+
+    def _origin_label(self) -> str:
+        """Node label carried on outgoing lifecycle messages: the
+        builder-set cs.trace_node, falling back to our p2p node id."""
+        label = self.cs.trace_node
+        if label:
+            return label
+        sw = self.switch
+        ni = getattr(sw, "node_info_fn", None) if sw is not None else None
+        try:
+            return ni().node_id[:16] if ni is not None else ""
+        except Exception:
+            return ""
+
+    def _stamped(self, msg) -> bytes:
+        """Encode a lifecycle message (Proposal/BlockPart/Vote) with a
+        cross-node origin tag (libs/tracing.py). ALL reactor sends of
+        the three lifecycle types go through here — check_spans.py
+        lints the parity. Non-lifecycle messages pass through
+        unstamped."""
+        if isinstance(msg, m.VoteMessage):
+            msg.origin = tracing.origin_stamp(
+                self._origin_label(), msg.vote.height, msg.vote.round)
+        elif isinstance(msg, m.ProposalMessage):
+            msg.origin = tracing.origin_stamp(
+                self._origin_label(), msg.proposal.height,
+                msg.proposal.round)
+        elif isinstance(msg, m.BlockPartMessage):
+            msg.origin = tracing.origin_stamp(
+                self._origin_label(), msg.height, msg.round)
+        return m.encode_consensus_msg(msg)
+
     def get_channels(self) -> list[ChannelDescriptor]:
         # priorities/capacities follow reference reactor.go GetChannels
         return [
@@ -346,6 +379,13 @@ class ConsensusReactor(Reactor):
 
     async def receive(self, chan_id: int, peer, msgb: bytes) -> None:
         msg = m.decode_consensus_msg(msgb)
+        # Origin rehydration: the connection's recv routine runs us
+        # inside a live p2p.recv_msg span — fold the sender's tag
+        # (node, height, round, send-side span id) into its attrs so
+        # this receive links to the send span on the origin node.
+        origin = getattr(msg, "origin", None)
+        if origin is not None:
+            tracing.rehydrate_origin(origin)
         ps = self.peer_states.get(peer.id)
         if ps is None:
             return
@@ -481,7 +521,7 @@ class ConsensusReactor(Reactor):
             for i, peer in enumerate(list(self.switch.peers.values())):
                 pair = (vote_a, vote_b) if i % 2 == 0 else (vote_b, vote_a)
                 for msg in pair:
-                    peer.try_send(VOTE_CHANNEL, m.encode_consensus_msg(msg))
+                    peer.try_send(VOTE_CHANNEL, self._stamped(msg))
         elif event == "proposal_split":
             # Maverick double-proposal: odd peers get the alternate
             # proposal + its parts directly (even peers see the primary
@@ -490,10 +530,10 @@ class ConsensusReactor(Reactor):
             for i, peer in enumerate(list(self.switch.peers.values())):
                 if i % 2 == 0:
                     continue
-                peer.try_send(DATA_CHANNEL, m.encode_consensus_msg(
-                    m.ProposalMessage(prop_b)))
+                peer.try_send(DATA_CHANNEL,
+                              self._stamped(m.ProposalMessage(prop_b)))
                 for j in range(parts_b.total):
-                    peer.try_send(DATA_CHANNEL, m.encode_consensus_msg(
+                    peer.try_send(DATA_CHANNEL, self._stamped(
                         m.BlockPartMessage(prop_b.height, prop_b.round,
                                            parts_b.get_part(j))))
 
@@ -567,7 +607,7 @@ class ConsensusReactor(Reactor):
                 if rs.height == ps.height and proposal is not None \
                         and ps.round == proposal.round \
                         and not ps.proposal:
-                    await peer.send(DATA_CHANNEL, m.encode_consensus_msg(
+                    await peer.send(DATA_CHANNEL, self._stamped(
                         m.ProposalMessage(proposal)))
                     ps.set_proposal(proposal)
                     if parts is not None:
@@ -599,7 +639,7 @@ class ConsensusReactor(Reactor):
         part = parts.get_part(idx)
         if part is None:
             return False
-        await ps.peer.send(DATA_CHANNEL, m.encode_consensus_msg(
+        await ps.peer.send(DATA_CHANNEL, self._stamped(
             m.BlockPartMessage(height=height, round=round_, part=part)))
         ps.set_has_part(height, round_, idx)
         return True
@@ -639,7 +679,7 @@ class ConsensusReactor(Reactor):
             part = self.cs.block_store.load_block_part(height, idx)
             if part is None:
                 break
-            await ps.peer.send(DATA_CHANNEL, m.encode_consensus_msg(
+            await ps.peer.send(DATA_CHANNEL, self._stamped(
                 m.BlockPartMessage(height=height, round=round_,
                                    part=part)))
             ps.set_has_part(height, round_, idx)
@@ -749,8 +789,8 @@ class ConsensusReactor(Reactor):
             vote = self._commit_to_vote(commit, idx)
             if vote is None:
                 continue
-            await ps.peer.send(VOTE_CHANNEL, m.encode_consensus_msg(
-                m.VoteMessage(vote)))
+            await ps.peer.send(VOTE_CHANNEL,
+                               self._stamped(m.VoteMessage(vote)))
             bits.set(idx, True)
             sent = True
         return sent
@@ -783,7 +823,7 @@ class ConsensusReactor(Reactor):
         if vote is None:
             return False
         ok = await ps.peer.send(VOTE_CHANNEL,
-                                m.encode_consensus_msg(m.VoteMessage(vote)))
+                                self._stamped(m.VoteMessage(vote)))
         if ok:
             logger.debug("sent vote h=%d r=%d t=%d idx=%d to %s",
                          vote.height, vote.round, int(vote.type), idx,

@@ -58,6 +58,12 @@ class ConsensusState(Service):
     # __init__ before any instance attribute could be assigned.
     _ht_span = None
     _step_span = None
+    # Node label for height forensics: when non-empty, every height/
+    # step span carries node=<label> and outgoing lifecycle messages
+    # are origin-stamped with it. Set by the builder (moniker) after
+    # construction; "" (the default) disables both — single-node use
+    # needs no identity. Class-level for the same __init__ reason.
+    trace_node = ""
 
     def __init__(self, config: ConsensusConfig, state: SmState,
                  block_exec: BlockExecutor, block_store: BlockStore,
@@ -226,6 +232,8 @@ class ConsensusState(Service):
         # and a height must never parent under a vote batch.
         self._ht_span = t.begin(tracing.CONSENSUS_HEIGHT,
                                 parent=tracing.NOOP_SPAN, height=height)
+        if self.trace_node:
+            self._ht_span.set_attr("node", self.trace_node)
 
     def reconstruct_last_commit(self) -> None:
         """Rebuild rs.last_commit from the stored seen commit
@@ -400,6 +408,8 @@ class ConsensusState(Service):
         self._step_span = tracing.TRACER.begin(
             tracing.consensus_step_kind(step.name), parent=self._ht_span,
             height=self.rs.height, round=self.rs.round)
+        if self.trace_node:
+            self._step_span.set_attr("node", self.trace_node)
         rsm = RoundStateMessage(self.rs.height, self.rs.round, int(step))
         self._wal_write(rsm)
         if self.event_bus is not None:
@@ -496,6 +506,10 @@ class ConsensusState(Service):
         except Exception as e:
             self.logger.error("failed to sign proposal: %r", e)
             return
+        # Forensics anchor: this node built the block for this round.
+        # The collector picks the proposer's propose span by this attr.
+        if self._step_span is not None:
+            self._step_span.set_attr("proposer", True)
         self._send_internal(m.ProposalMessage(proposal))
         for i in range(parts.total):
             self._send_internal(m.BlockPartMessage(height, round_,
@@ -664,6 +678,12 @@ class ConsensusState(Service):
             return
         rs.commit_round = commit_round
         rs.commit_time = _clock.monotonic()
+        # Forensics anchor: the instant the precommit quorum landed
+        # here (enter_commit fires exactly on +2/3). Stamped on the
+        # height root so the collector reads it without span joins.
+        if self._ht_span is not None:
+            self._ht_span.set_attr("precommit_quorum_ns",
+                                   _time.perf_counter_ns())
         self._new_step(RoundStep.COMMIT)
 
         precommits = rs.votes.precommits(commit_round)
@@ -864,6 +884,12 @@ class ConsensusState(Service):
                 raise VoteSetError(
                     "completed block hash != proposal block id")
             rs.proposal_block = block
+            # Forensics anchor: first full part set on this node (the
+            # proposer hits it too, via its own internal loopback).
+            prior = getattr(self._ht_span, "attrs", None) or {}
+            if self._ht_span is not None and "parts_complete_ns" not in prior:
+                self._ht_span.set_attr("parts_complete_ns",
+                                       _time.perf_counter_ns())
             if self.event_bus is not None:
                 self.event_bus.publish_complete_proposal(EventDataRoundState(
                     rs.height, rs.round, "CompleteProposal"

@@ -348,6 +348,9 @@ class Runner:
             # so the pool reports caught-up and switches to consensus
             # immediately.
             cfg.base.fast_sync = True
+            # distinct monikers: they label each node's trace spans +
+            # origin tags (height forensics), and "node" x N is useless
+            cfg.base.moniker = f"node{i}"
             cfg.consensus.timeout_commit_ms = self.m.timeout_commit_ms
             # Test-speed PEX cadence for EVERY e2e node (the request
             # rate limits scale with it, p2p/pex/reactor.py): a
@@ -558,6 +561,61 @@ class Runner:
             writer.close()
         _, _, body = raw.partition(b"\r\n\r\n")
         return body
+
+    async def collect_timeline(self) -> dict | None:
+        """Height forensics over the live net (best-effort): pull each
+        node's clock anchor + the last committed heights' spans from
+        its debug server, reconstruct cross-node TIMELINE lines, and
+        return the run summary (tools/forensics.timeline_summary).
+        None when no node exposes a debug endpoint or nothing
+        reconstructs — the report simply omits the section."""
+        import json
+
+        from ..tools import forensics
+
+        nodes = [n for n in self.nodes if n.pprof_port
+                 and n.proc is not None and n.proc.poll() is None]
+        if not nodes:
+            return None
+        anchors: dict[int, int] = {}
+        for n in nodes:
+            try:
+                a = json.loads(await self._debug_get(
+                    n, "/debug/trace/anchor"))
+                anchors[n.index] = a["wall_ns"] - a["mono_ns"]
+            except Exception:
+                pass
+        # candidates: recent commit spans anywhere in the fleet
+        heights: set[int] = set()
+        per_node_docs: dict[int, dict] = {}
+        for n in nodes:
+            try:
+                doc = json.loads(await self._debug_get(n, "/debug/trace"))
+            except Exception:
+                continue
+            per_node_docs[n.index] = doc
+            for ev in doc.get("traceEvents", []):
+                if ev.get("name") == "consensus.commit":
+                    h = (ev.get("args") or {}).get("height")
+                    if h:
+                        heights.add(h)
+        timelines = []
+        for h in sorted(heights)[-8:]:
+            views: dict = {}
+            for n in nodes:
+                doc = per_node_docs.get(n.index)
+                if doc is None:
+                    continue
+                views.update(forensics.from_chrome(
+                    doc, h, f"node{n.index}",
+                    offset_ns=anchors.get(n.index, 0)))
+            tl = forensics.build_timeline(views, h)
+            if tl is not None:
+                timelines.append(tl)
+                self.log(f"TIMELINE {json.dumps(tl, sort_keys=True)}")
+        if not timelines:
+            return None
+        return forensics.timeline_summary(timelines)
 
     @staticmethod
     def _sum_metric(metrics_text: str, name: str) -> float:
@@ -1237,6 +1295,13 @@ class Runner:
                 report["light_proxy"] = self.light_proxy_reports
             if self.spec_mismatch_reports:
                 report["spec_mismatch"] = self.spec_mismatch_reports
+            try:
+                timeline = await self.collect_timeline()
+            except Exception as e:  # forensics never fails the run
+                self.log(f"timeline collection failed: {e!r}")
+                timeline = None
+            if timeline is not None:
+                report["timeline"] = timeline
             return report
         finally:
             self.stop_load()

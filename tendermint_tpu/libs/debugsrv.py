@@ -41,6 +41,7 @@ from __future__ import annotations
 import asyncio
 import io
 import logging
+import os
 import sys
 import time
 import traceback
@@ -462,8 +463,25 @@ class DebugServer:
             # — do it off the event loop so a trace capture (or a
             # polling `debug dump`) never stalls consensus/gossip.
             recs = TRACER.snapshot(seconds=secs or None)
+            # ?height=H server-side filter: the forensics collector
+            # wants one height's spans per node, not whole rings.
+            # Matches spans whose attrs carry height==H (consensus
+            # timeline + origin-rehydrated recv spans).
+            hraw = params.get("height")
+            if hraw is not None:
+                try:
+                    hwant = int(hraw)
+                except ValueError:
+                    hwant = None
+                if hwant is not None:
+                    recs = [r for r in recs if r[6] and (
+                        r[6].get("height") == hwant or
+                        r[6].get("origin_height") == hwant)]
+            # Ring-health meta rides every export: a collector must be
+            # able to tell a truncated trace from a complete one.
+            meta = {"capacity": TRACER.capacity, "dropped": TRACER.dropped}
             body = await asyncio.get_running_loop().run_in_executor(
-                None, lambda: json.dumps(chrome_trace(recs)).encode())
+                None, lambda: json.dumps(chrome_trace(recs, meta)).encode())
             return body, b"application/json"
         if path == "/debug/trace/rollup":
             import json
@@ -471,10 +489,35 @@ class DebugServer:
             from .tracing import TRACER
 
             secs = _parse_seconds(params.get("seconds"), 0.0, cap=3600.0)
+
+            def render() -> bytes:
+                return json.dumps({
+                    "stages": TRACER.stage_rollup(seconds=secs or None),
+                    "capacity": TRACER.capacity,
+                    "spans_dropped": TRACER.dropped,
+                }).encode()
+
             body = await asyncio.get_running_loop().run_in_executor(
-                None, lambda: json.dumps(
-                    TRACER.stage_rollup(seconds=secs or None)).encode())
+                None, render)
             return body, b"application/json"
+        if path == "/debug/trace/anchor":
+            import json
+            import time as _t
+
+            from .tracing import TRACER
+
+            # Monotonic-clock anchor for cross-process correlation:
+            # span timestamps are per-process perf_counter_ns, so the
+            # forensics collector maps them onto a shared axis via
+            # offset = wall_ns - mono_ns sampled here (back-to-back,
+            # so the pairing error is sub-µs).
+            return (json.dumps({
+                "mono_ns": _t.perf_counter_ns(),
+                "wall_ns": _t.time_ns(),
+                "pid": os.getpid(),
+                "capacity": TRACER.capacity,
+                "spans_dropped": TRACER.dropped,
+            }).encode(), b"application/json")
         if path == "/metrics":
             from .metrics import DEFAULT, node_metrics
 

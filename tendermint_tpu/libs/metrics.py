@@ -777,6 +777,11 @@ class TracingMetrics:
     span_seconds: Histogram = field(default_factory=lambda: DEFAULT.histogram(
         "span_seconds", "Span duration by registered kind "
         "(bridge-fed from every span close).", "tracing"))
+    spans_dropped: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "spans_dropped_total",
+        "Spans evicted from the trace ring buffer (overflow) — "
+        "non-zero means /debug/trace is a suffix of the timeline, "
+        "not the whole of it.", "tracing"))
 
 
 _SINGLETONS: dict[str, object] = {}
@@ -1050,6 +1055,13 @@ def span_metrics_sink(kind: str, seconds: float) -> None:
     ob.observe(seconds)
 
 
+def span_drop_sink(n: int) -> None:
+    """Installed into the global TRACER: counts ring-buffer evictions
+    so a truncated trace export is detectable from /metrics alone."""
+    tracing_metrics().spans_dropped.inc(n)
+
+
 # One instrumentation point, two exports: the ring buffer keeps the
 # per-event timeline, the sink keeps the aggregate histograms.
 _tracing.TRACER.set_metrics_sink(span_metrics_sink)
+_tracing.TRACER.set_drop_sink(span_drop_sink)

@@ -50,9 +50,11 @@ from __future__ import annotations
 import contextvars
 import itertools
 import os
+import struct
 import threading
 import time
 from collections import deque
+from typing import NamedTuple
 
 # ---------------------------------------------------------------- registry
 
@@ -160,6 +162,19 @@ class Span:
         self._done = True
         t1 = time.perf_counter_ns()
         tracer = self._tracer
+        # Ring-overflow accounting: deque(maxlen=N) evicts silently, so
+        # a truncated timeline would be indistinguishable from a complete
+        # one. len() on a deque is O(1); the increment is GIL-atomic
+        # enough for a monitoring counter (exactness is not load-bearing,
+        # non-zero-ness is).
+        if len(tracer._ring) >= tracer.capacity:
+            tracer._dropped += 1
+            dsink = tracer.drop_sink
+            if dsink is not None:
+                try:
+                    dsink(1)
+                except Exception:
+                    pass
         tracer._ring.append((
             self.kind, self.span_id, self.parent_id, self.tid,
             self.t0, t1 - self.t0, self.attrs,
@@ -255,13 +270,26 @@ class Tracer:
         self.capacity = capacity
         self.enabled = enabled
         self._ring: deque = deque(maxlen=capacity)
+        self._dropped = 0
         # tracing→metrics bridge: fn(kind, seconds) called on every
         # span close (libs/metrics.py installs span_metrics_sink on
         # the global TRACER). None = no bridge (private test tracers).
         self.metrics_sink = None
+        # eviction bridge: fn(n) on every ring overflow — feeds
+        # tracing_spans_dropped_total. Same None-means-no-bridge rule.
+        self.drop_sink = None
 
     def set_metrics_sink(self, sink) -> None:
         self.metrics_sink = sink
+
+    def set_drop_sink(self, sink) -> None:
+        self.drop_sink = sink
+
+    @property
+    def dropped(self) -> int:
+        """Spans evicted from the ring since the last clear() — a
+        non-zero value means snapshot() is a suffix, not the history."""
+        return self._dropped
 
     # -- recording --
 
@@ -313,6 +341,7 @@ class Tracer:
 
     def clear(self) -> None:
         self._ring.clear()
+        self._dropped = 0
 
     def __len__(self) -> int:
         return len(self._ring)
@@ -358,18 +387,95 @@ class Tracer:
 TRACER = Tracer()
 
 
+# ---------------------------------------------------------------- origin tags
+#
+# Cross-node trace context. A compact binary tag rides the consensus
+# wire messages that define the block lifecycle (Proposal, BlockPart,
+# Vote): the sender stamps (height, round, its node label, the span id
+# active at send time), the receiver rehydrates the tag into the attrs
+# of its live p2p.recv_msg span. A part's recv span on node B thus
+# names its send span on node A — zero new hot-path span sites, and
+# peers that never set the field are untouched (the wire field is
+# optional; old decoders skip it as an unknown proto field).
+
+_ORIGIN_VERSION = 1
+_ORIGIN_HDR = struct.Struct(">BQIQ")  # version, height, round, span_id
+_ORIGIN_MAX_NODE = 64  # label bytes cap: tags stay wire-cheap
+
+
+class OriginTag(NamedTuple):
+    height: int
+    round: int
+    node: str
+    span_id: int
+
+
+def encode_origin(height: int, round_: int, node: str,
+                  span_id: int = 0) -> bytes:
+    """Binary origin tag: 21-byte fixed header + UTF-8 node label
+    (truncated to 64 bytes). Total ≤ 85 bytes per stamped message."""
+    label = node.encode("utf-8", "replace")[:_ORIGIN_MAX_NODE]
+    return _ORIGIN_HDR.pack(
+        _ORIGIN_VERSION, height & (2**64 - 1), round_ & (2**32 - 1),
+        span_id & (2**64 - 1)) + label
+
+
+def decode_origin(data: bytes | None) -> OriginTag | None:
+    """Parse an origin tag; never raises. None on absent/short/
+    unknown-version payloads — a garbled tag degrades to 'no tag',
+    it must not take down message decode."""
+    if not data or len(data) < _ORIGIN_HDR.size:
+        return None
+    try:
+        ver, height, round_, span_id = _ORIGIN_HDR.unpack_from(data)
+        if ver != _ORIGIN_VERSION:
+            return None
+        node = data[_ORIGIN_HDR.size:].decode("utf-8", "replace")
+        return OriginTag(height, round_, node, span_id)
+    except Exception:
+        return None
+
+
+def origin_stamp(node: str, height: int, round_: int) -> bytes:
+    """Send-side: build the tag for an outgoing lifecycle message,
+    capturing the task-local active span (0 if none — the node/height/
+    round triple still carries the cross-node link)."""
+    cur = _CURRENT.get()
+    return encode_origin(height, round_, node,
+                         cur.span_id if cur is not None else 0)
+
+
+def rehydrate_origin(data: bytes | None) -> OriginTag | None:
+    """Recv-side: decode an incoming tag and fold it into the attrs of
+    the live current span (the p2p.recv_msg span wrapping reactor
+    dispatch), linking this receive to the sender's send-side span."""
+    tag = decode_origin(data)
+    if tag is None:
+        return None
+    cur = _CURRENT.get()
+    if cur is not None:
+        cur.set_attr("origin_node", tag.node)
+        cur.set_attr("origin_height", tag.height)
+        cur.set_attr("origin_round", tag.round)
+        if tag.span_id:
+            cur.set_attr("origin_span", tag.span_id)
+    return tag
+
+
 # ---------------------------------------------------------------- export
 
 _PID = os.getpid()
 
 
-def chrome_trace(records: list[tuple]) -> dict:
+def chrome_trace(records: list[tuple], meta: dict | None = None) -> dict:
     """Chrome trace-event JSON (the `traceEvents` array object form)
     from snapshot() tuples: one "X" (complete) event per span, ts/dur
     in microseconds, parent links + attributes under args. Loads
     directly in Perfetto / chrome://tracing; nesting renders from
     ts/dur containment per (pid, tid) track, and args.parent_id gives
-    exact cross-thread lineage."""
+    exact cross-thread lineage. `meta` (ring capacity, drop counter,
+    clock anchor...) lands under a top-level "tm_tpu" key — viewers
+    ignore unknown top-level keys, collectors read it."""
     events = []
     for kind, span_id, parent_id, tid, t0, dur, attrs in records:
         args = {"span_id": span_id}
@@ -387,4 +493,7 @@ def chrome_trace(records: list[tuple]) -> dict:
             "tid": tid,
             "args": args,
         })
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+    out = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if meta is not None:
+        out["tm_tpu"] = meta
+    return out

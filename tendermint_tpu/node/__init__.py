@@ -266,6 +266,9 @@ class Node(Service):
             mempool=self.mempool, evpool=self.evpool,
             wal=None if self.in_memory else WAL(wal_path),
             event_bus=self.event_bus, speculation=self.speculation)
+        # Height forensics: label this node's spans + origin-stamp its
+        # outgoing lifecycle messages with the configured moniker.
+        self.consensus_state.trace_node = cfg.base.moniker
         self.consensus_state.misbehaviors.update(self.misbehaviors)
         if (self.priv_validator is None
                 and cfg.base.priv_validator_laddr):

@@ -240,6 +240,7 @@ class SimNode:
         self.cs = ConsensusState(self.config, state, executor,
                                  self.block_store, mempool=self.mempool,
                                  evpool=self.evpool)
+        self.cs.trace_node = f"sim{self.index}"
         if self.pv is not None:
             self.cs.set_priv_validator(self.pv)
         self.cs.misbehaviors.update(self.misbehavior_schedule)

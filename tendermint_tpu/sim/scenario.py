@@ -58,6 +58,8 @@ INVARIANTS = {
     "liveness_after_heal": "commits resume after the last fault heals",
     "bounded_queues": "tracked bounded queues never exceed capacity",
     "determinism": "same (scenario, seed) reproduces identical app hashes",
+    "timeline_attribution": "collected height timelines reconstruct with "
+                            "a proposer and full stage attribution",
 }
 
 
@@ -104,6 +106,12 @@ class Scenario:
     # drivers — tests use it to sample live state (trust scores, peer
     # sets) at virtual times without patching the runner
     probe = None
+    # Height forensics: when True, the runner clears the global TRACER
+    # at scenario start and folds per-height TIMELINE dicts (tools/
+    # forensics.py) into report["timeline"], checked by the
+    # timeline_attribution invariant. Off by default — a cleared
+    # tracer ring is process-global state a test may not expect.
+    collect_timeline: bool = False
 
     def byzantine_specs(self) -> list:
         out = []
@@ -217,6 +225,10 @@ def run_scenario(scenario: Scenario, seed: int) -> dict:
         "final_heights": [], "restarts": [], "net": {}, "chain": [],
         "app_hashes": [], "evidence_committed": 0,
     }
+    if scenario.collect_timeline:
+        from ..libs import tracing as _tracing
+
+        _tracing.TRACER.clear()
     try:
         loop.run_until_complete(_run(scenario, seed, report))
     except SimStallError as e:
@@ -424,6 +436,19 @@ def _collect(sc: Scenario, seed: int, nodes: list, net: SimNetwork,
         e.get("app_hash") for e in chain if e is not None]
     report["evidence_committed"] = evidence
 
+    if sc.collect_timeline:
+        from ..libs import tracing as _tracing
+        from ..tools import forensics
+
+        recs = _tracing.TRACER.snapshot()
+        # only heights the whole run is past: the tip height's spans
+        # are still open (a live height span isn't in the ring yet)
+        done = [h for h in forensics.committed_heights(recs)
+                if h < max(heights)]
+        report["timeline"] = [forensics.timeline_from_ring(recs, h)
+                              for h in done]
+        report["timeline_dropped_spans"] = _tracing.TRACER.dropped
+
 
 def _oracle_app_hashes(node, upto: int) -> dict:
     """Independent fold of the committed txs through the kvstore hash
@@ -482,6 +507,29 @@ def _check_invariants(sc: Scenario, seed: int, nodes: list,
     # liveness after the last heal: the net as a whole must keep
     # committing, and every node that was up at the end must have
     # moved past its at-heal height
+    # timeline attribution (collect_timeline scenarios only): every
+    # reconstructed height must name a proposer, and a fault-free
+    # scenario must attribute every stage on every line — a None
+    # stage means a lost anchor, i.e. the instrument itself regressed
+    if sc.collect_timeline:
+        from ..tools import forensics as _forensics
+
+        tls = [t for t in report.get("timeline", []) if t]
+        if not tls:
+            v.append(f"timeline_attribution: no height reconstructed "
+                     f"{tag}")
+        for t in tls:
+            if not t["proposer"]:
+                v.append(f"timeline_attribution: height {t['height']} "
+                         f"has no proposer {tag}")
+            if not sc.faults and not sc.byzantine:
+                missing = [s for s in _forensics.STAGES
+                           if t["stages"][s]["ms"] is None]
+                if missing:
+                    v.append(
+                        f"timeline_attribution: height {t['height']} "
+                        f"missing stages {missing} {tag}")
+
     at_heal = report.get("heights_at_heal")
     if at_heal is not None:
         if max_h < max(at_heal) + 2:

@@ -75,6 +75,7 @@ class P2PNode:
         self.cs = ConsensusState(fast_consensus_config(), state, executor,
                                  self.block_store, evpool=self.evpool,
                                  speculation=spec_plane)
+        self.cs.trace_node = self.moniker
         if self.pv is not None:
             self.cs.set_priv_validator(self.pv)
         self.reactor = ConsensusReactor(self.cs, wait_sync=wait_sync,

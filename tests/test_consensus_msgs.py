@@ -62,3 +62,30 @@ def test_new_valid_block():
     out = roundtrip(m.NewValidBlockMessage(6, 0, PartSetHeader(3, b"\x07" * 32),
                                            bits, True))
     assert out.is_commit and out.block_parts_header.total == 3
+
+
+def test_origin_tag_field_roundtrips_on_lifecycle_msgs():
+    """The optional origin tag (field 15, opaque bytes) survives the
+    wire on all three lifecycle messages, and its ABSENCE encodes
+    byte-identically to the pre-tag format — a peer that never stamps
+    is indistinguishable from an old binary."""
+    from tendermint_tpu.libs import tracing
+
+    tag = tracing.encode_origin(5, 1, "val0", span_id=77)
+    p = Proposal(5, 1, -1, _bid(), timestamp=123456789,
+                 signature=b"\x55" * 64)
+    part = Part(2, b"chunk-bytes", Proof(4, 2, b"\x03" * 32,
+                                         [b"\x04" * 32, b"\x05" * 32]))
+    v = Vote(VoteType.PRECOMMIT, 5, 1, _bid(), 999, b"\xaa" * 20, 2,
+             b"\x66" * 64)
+    for msg in (m.ProposalMessage(p, origin=tag),
+                m.BlockPartMessage(5, 1, part, origin=tag),
+                m.VoteMessage(v, origin=tag)):
+        out = roundtrip(msg)
+        assert out.origin == tag
+        assert tracing.decode_origin(out.origin).node == "val0"
+        # origin=None round-trips to None AND adds zero wire bytes
+        bare = type(msg)(**{**msg.__dict__, "origin": None})
+        enc = m.encode_consensus_msg(bare)
+        assert m.decode_consensus_msg(enc).origin is None
+        assert len(enc) == len(m.encode_consensus_msg(msg)) - 2 - len(tag)

@@ -79,6 +79,72 @@ def test_ring_buffer_eviction_under_overflow():
     assert lanes == list(range(42, 50))  # oldest evicted, order kept
 
 
+def test_ring_eviction_counts_dropped_spans():
+    """Evictions are COUNTED, not silent: `dropped` says how many
+    spans `/debug/trace` can no longer show, the drop sink bridges the
+    count to tracing_spans_dropped_total, and clear() resets it."""
+    t = Tracer(capacity=8)
+    sunk = []
+    t.set_drop_sink(sunk.append)
+    for i in range(50):
+        with t.span(tracing.CRYPTO_PACK, lanes=i):
+            pass
+    assert t.dropped == 42
+    assert sum(sunk) == 42
+    # a raising sink never breaks the span path
+    t.set_drop_sink(lambda n: 1 / 0)
+    with t.span(tracing.CRYPTO_PACK, lanes=99):
+        pass
+    assert t.dropped == 43
+    t.clear()
+    assert t.dropped == 0 and len(t) == 0
+
+
+def test_origin_tag_codec_roundtrip_and_garbage_tolerance():
+    tag = tracing.encode_origin(12345, 3, "sim2", span_id=0xDEADBEEF)
+    dec = tracing.decode_origin(tag)
+    assert dec == tracing.OriginTag(12345, 3, "sim2", 0xDEADBEEF)
+    # never raises on garbage: truncated, empty, wrong version
+    assert tracing.decode_origin(b"") is None
+    assert tracing.decode_origin(b"\x01\x02") is None
+    assert tracing.decode_origin(b"\xff" + tag[1:]) is None
+    assert tracing.decode_origin(tag[:5]) is None
+    # node labels cap at 64 bytes on the wire
+    long = tracing.decode_origin(tracing.encode_origin(1, 0, "x" * 200))
+    assert len(long.node) == 64
+
+
+def test_origin_stamp_and_rehydrate_attach_to_current_span():
+    """origin_stamp captures the CURRENT span's id at send; on the
+    receiver rehydrate_origin folds the decoded tag into the current
+    (recv) span's attrs. No current span -> stamp still encodes
+    (span_id 0) and rehydrate is a no-op, never an error."""
+    t = Tracer(capacity=32)
+    tok = tracing._CURRENT.set(None)
+    try:
+        with t.span(tracing.CONSENSUS_PROPOSE, height=9) as send_sp:
+            tag = tracing.origin_stamp("val1", 9, 2)
+        dec = tracing.decode_origin(tag)
+        assert dec.node == "val1" and dec.height == 9 and dec.round == 2
+        assert dec.span_id == send_sp.span_id
+
+        with t.span(tracing.P2P_RECV_MSG, chan=0x21):
+            tracing.rehydrate_origin(tag)
+        recv = t.snapshot()[-1]
+        assert recv[6]["origin_node"] == "val1"
+        assert recv[6]["origin_height"] == 9
+        assert recv[6]["origin_round"] == 2
+        assert recv[6]["origin_span"] == send_sp.span_id
+
+        # outside any span: no crash, nothing recorded
+        bare = tracing.origin_stamp("val1", 10, 0)
+        assert tracing.decode_origin(bare).span_id == 0
+        tracing.rehydrate_origin(bare)
+        tracing.rehydrate_origin(b"not-a-tag")
+    finally:
+        tracing._CURRENT.reset(tok)
+
+
 def test_disabled_tracer_records_nothing():
     t = Tracer(capacity=8, enabled=False)
     with t.span(tracing.CRYPTO_PACK, lanes=1) as sp:

@@ -1,0 +1,187 @@
+"""Bench trajectory table + regression/misrepresentation gate over
+BENCH_r*.json rounds.
+
+Round 5 taught the lesson this tool encodes: r04 and r05 silently ran
+on TFRT_CPU_0 (the relay wedge) and their numbers sat next to r01's
+real TPU measurement as if they continued the same curve. Bench rounds
+are only comparable WITHIN a backend, so this tool:
+
+  1. classifies every round — `silicon`, `cpu_fallback`, or `no-data`
+     (parsed null: crashed/timed-out runs) — from the parsed payload's
+     explicit stamps (`backend`, `cpu_fallback`) with the device
+     string as the cross-check,
+  2. prints the trajectory table hard-separated by backend,
+  3. flags `regression` when the headline value grows >10% between
+     consecutive MEASURED rounds of the SAME backend (for rate-like
+     units, a >10% drop), and
+  4. flags `misrepresented` when a round's stamps contradict each
+     other — a `cpu_fallback`/CPU-device round carrying a silicon
+     backend stamp. Under `--check`, any regression or
+     misrepresentation exits non-zero; the suite runs this so a future
+     fallback round can never silently extend the silicon trajectory.
+
+Usage:
+    python tools/bench_trend.py [--check] [--glob 'BENCH_r*.json'] [DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import os
+import sys
+
+REGRESSION_PCT = 10.0
+
+_CPU_DEVICE_MARKERS = ("cpu", "host")
+_SILICON_BACKENDS = ("tpu", "silicon", "device")
+
+
+def _device_is_cpu(device: str) -> bool:
+    d = device.lower()
+    return any(m in d for m in _CPU_DEVICE_MARKERS)
+
+
+def _rate_unit(unit: str) -> bool:
+    u = (unit or "").lower()
+    return "/s" in u or "per_sec" in u or "per sec" in u
+
+
+def classify(entry: dict) -> dict:
+    """One BENCH_r*.json -> {round, backend, value, unit, device,
+    problems}. backend ∈ silicon | cpu_fallback | no-data."""
+    parsed = entry.get("parsed")
+    row = {"round": entry.get("n"), "rc": entry.get("rc"),
+           "backend": "no-data", "value": None, "unit": None,
+           "device": None, "metric": None, "problems": []}
+    if not isinstance(parsed, dict):
+        return row
+    device = str(parsed.get("device", ""))
+    row["device"] = device or None
+    row["value"] = parsed.get("value")
+    row["unit"] = parsed.get("unit")
+    row["metric"] = parsed.get("metric")
+    fallback_stamp = bool(parsed.get("cpu_fallback"))
+    backend_stamp = str(parsed.get("backend", "")).lower()
+
+    if backend_stamp:
+        claims_silicon = any(b in backend_stamp
+                             for b in _SILICON_BACKENDS) and \
+            "cpu" not in backend_stamp
+        if claims_silicon and (fallback_stamp or _device_is_cpu(device)):
+            row["backend"] = "cpu_fallback"
+            row["problems"].append(
+                f"misrepresented: backend stamp {backend_stamp!r} but "
+                f"cpu_fallback={fallback_stamp} device={device!r}")
+        else:
+            row["backend"] = ("silicon" if claims_silicon
+                              else "cpu_fallback")
+    elif fallback_stamp or (device and _device_is_cpu(device)):
+        row["backend"] = "cpu_fallback"
+    elif device:
+        row["backend"] = "silicon"
+    else:
+        # a measured value with no device/backend evidence at all
+        # cannot claim the silicon trajectory
+        row["backend"] = "cpu_fallback"
+        row["problems"].append(
+            "unattributed: measured value with no device/backend stamp")
+    return row
+
+
+def load_rounds(paths: list[str]) -> list[dict]:
+    rows = []
+    for p in sorted(paths):
+        try:
+            with open(p) as f:
+                entry = json.load(f)
+        except (OSError, ValueError) as e:
+            rows.append({"round": os.path.basename(p), "rc": None,
+                         "backend": "no-data", "value": None,
+                         "unit": None, "device": None, "metric": None,
+                         "problems": [f"unreadable: {e!r}"]})
+            continue
+        row = classify(entry)
+        row["file"] = os.path.basename(p)
+        rows.append(row)
+    return rows
+
+
+def find_regressions(rows: list[dict]) -> list[str]:
+    """>10% headline-value growth (or rate drop) between consecutive
+    MEASURED rounds of the same backend. no-data rounds don't break
+    the chain — r01 vs a hypothetical silicon r06 still compares."""
+    out = []
+    last_by_backend: dict[str, dict] = {}
+    for row in rows:
+        b = row["backend"]
+        if b == "no-data" or row["value"] is None:
+            continue
+        prev = last_by_backend.get(b)
+        if prev is not None and prev["value"]:
+            if _rate_unit(row["unit"]):
+                delta = (prev["value"] - row["value"]) / prev["value"]
+                verb = "dropped"
+            else:
+                delta = (row["value"] - prev["value"]) / prev["value"]
+                verb = "grew"
+            if delta * 100.0 > REGRESSION_PCT:
+                out.append(
+                    f"regression[{b}]: {prev.get('file')} -> "
+                    f"{row.get('file')}: {row['metric']} {verb} "
+                    f"{delta * 100.0:.1f}% ({prev['value']} -> "
+                    f"{row['value']} {row['unit']})")
+        last_by_backend[b] = row
+    return out
+
+
+def render_table(rows: list[dict]) -> str:
+    lines = []
+    for backend in ("silicon", "cpu_fallback", "no-data"):
+        sel = [r for r in rows if r["backend"] == backend]
+        if not sel:
+            continue
+        lines.append(f"-- {backend} --")
+        for r in sel:
+            val = (f"{r['value']} {r['unit']}" if r["value"] is not None
+                   else f"(rc={r['rc']})")
+            flag = "  !! " + "; ".join(r["problems"]) if r["problems"] \
+                else ""
+            lines.append(f"  {r.get('file', r['round']):<18} {val:<18} "
+                         f"device={r['device']}{flag}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="BENCH_r*.json trajectory table + regression gate")
+    ap.add_argument("dir", nargs="?", default=".",
+                    help="directory holding the BENCH files")
+    ap.add_argument("--glob", default="BENCH_r*.json")
+    ap.add_argument("--check", action="store_true",
+                    help="exit non-zero on any regression or "
+                         "misrepresented round")
+    args = ap.parse_args(argv)
+
+    paths = _glob.glob(os.path.join(args.dir, args.glob))
+    if not paths:
+        print(f"no files match {args.glob} in {args.dir}",
+              file=sys.stderr)
+        return 2
+    rows = load_rounds(paths)
+    print(render_table(rows))
+
+    problems = [p for r in rows for p in r["problems"]]
+    regressions = find_regressions(rows)
+    for msg in problems + regressions:
+        print(f"TREND: {msg}")
+    if args.check and (problems or regressions):
+        print("FAILED")
+        return 1
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

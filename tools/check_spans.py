@@ -47,12 +47,16 @@ _SPAN_METHODS = {"span", "begin"}
 # tests) depend on BY NAME: renaming or dropping one silently empties
 # a dashboard row, so their registration is linted, not assumed.
 REQUIRED_KINDS = frozenset({
-    "consensus.height", "consensus.commit", "consensus.vote_batch",
+    "consensus.height", "consensus.propose", "consensus.commit",
+    "consensus.vote_batch",
     "crypto.batch", "crypto.verify", "crypto.pack", "crypto.dispatch",
     "crypto.device_exec", "crypto.readback", "crypto.host_verify",
     "speculation.speculate", "speculation.patch",
     "speculation.reconcile",
     "state.apply_block", "wal.fsync",
+    # height forensics reads these two by name: recv spans carry the
+    # rehydrated origin tags, send_flush is the wire-side counterpart
+    "p2p.recv_msg", "p2p.send_flush",
 })
 
 
@@ -107,6 +111,87 @@ def find_ad_hoc_spans(root: str = PKG) -> list[str]:
     return problems
 
 
+# The three consensus wire messages that carry a cross-node origin tag
+# (libs/tracing.py encode_origin; consensus/messages.py field 15).
+_LIFECYCLE_MSGS = {"ProposalMessage", "BlockPartMessage", "VoteMessage"}
+
+
+def find_origin_parity_problems() -> list[str]:
+    """Send-side stamp <-> recv-side rehydrate parity lint for the
+    consensus reactor (the module that owns every lifecycle send):
+
+      * every `encode_consensus_msg(<LifecycleMessage>(...))` call
+        outside the `_stamped` helper is a problem — a raw encode of a
+        freshly-constructed lifecycle message ships WITHOUT an origin
+        tag and its recv span on the far node dangles;
+      * `_stamped` itself must call tracing.origin_stamp;
+      * `receive` must call tracing.rehydrate_origin.
+
+    Empty list = clean."""
+    path = os.path.join(PKG, "consensus", "reactor.py")
+    rel = os.path.relpath(path, REPO)
+    with open(path, "rb") as f:
+        tree = ast.parse(f.read(), filename=rel)
+
+    problems = []
+    reactor = next(
+        (n for n in tree.body
+         if isinstance(n, ast.ClassDef) and n.name == "ConsensusReactor"),
+        None)
+    if reactor is None:
+        return [f"{rel}: ConsensusReactor class not found"]
+
+    def calls_named(fn: ast.AST, name: str) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (isinstance(f, ast.Attribute) and f.attr == name) or \
+                        (isinstance(f, ast.Name) and f.id == name):
+                    return True
+        return False
+
+    methods = {n.name: n for n in reactor.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    stamped = methods.get("_stamped")
+    if stamped is None:
+        problems.append(f"{rel}: ConsensusReactor._stamped missing")
+    elif not calls_named(stamped, "origin_stamp"):
+        problems.append(
+            f"{rel}:{stamped.lineno}: _stamped does not call "
+            "tracing.origin_stamp")
+    recv = methods.get("receive")
+    if recv is None:
+        problems.append(f"{rel}: ConsensusReactor.receive missing")
+    elif not calls_named(recv, "rehydrate_origin"):
+        problems.append(
+            f"{rel}:{recv.lineno}: receive does not call "
+            "tracing.rehydrate_origin")
+
+    for name, fn in methods.items():
+        if name == "_stamped":
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            is_encode = (isinstance(f, ast.Attribute)
+                         and f.attr == "encode_consensus_msg") or \
+                (isinstance(f, ast.Name) and f.id == "encode_consensus_msg")
+            if not is_encode or not node.args:
+                continue
+            arg = node.args[0]
+            if not isinstance(arg, ast.Call):
+                continue
+            cf = arg.func
+            cls = cf.attr if isinstance(cf, ast.Attribute) else \
+                cf.id if isinstance(cf, ast.Name) else ""
+            if cls in _LIFECYCLE_MSGS:
+                problems.append(
+                    f"{rel}:{node.lineno}: {name} encodes {cls} without "
+                    "an origin stamp — route it through self._stamped")
+    return problems
+
+
 def measure_overhead(n: int = 20000) -> tuple[float, float]:
     """(enabled_s_per_span, disabled_s_per_span) for an enter/exit of
     an attribute-carrying span on a private tracer. Best-of-3 batches:
@@ -139,6 +224,7 @@ def measure_overhead(n: int = 20000) -> tuple[float, float]:
 def main() -> int:
     sys.path.insert(0, REPO)
     problems = find_ad_hoc_spans()
+    problems += find_origin_parity_problems()
     problems += [f"required span kind {k!r} not registered "
                  "(libs/tracing.py)" for k in missing_required_kinds()]
     for p in problems:
