@@ -48,6 +48,19 @@ def _emit(d):
     print(json.dumps(d), flush=True)
 
 
+def ledger_rollup():
+    """Per-workload launch-ledger rollup (launch count, lanes, bytes,
+    backend mix, exec p50/p99 — crypto/tpu/ledger.py) embedded in
+    every measured BENCH line: the line itself then carries the
+    evidence of WHERE its launches ran, next to the backend stamp."""
+    try:
+        from tendermint_tpu.crypto.tpu import ledger as tpu_ledger
+
+        return tpu_ledger.rollup()["workloads"]
+    except Exception:
+        return {}
+
+
 # ----------------------------------------------------------------- worker
 
 def _measure(fn, reps, warmed=False):
@@ -165,7 +178,12 @@ def worker():
     def metrics_delta(before):
         return tmetrics.delta(before, tmetrics.snapshot())
 
-    from tools.silicon_record import backend_label
+    from tendermint_tpu.crypto.tpu import ledger as tpu_ledger
+    from tendermint_tpu.crypto.tpu.backend import backend_label
+
+    # every kernel launch below lands in the launch ledger under the
+    # "bench" workload (process-lifetime tag: the worker IS the bench)
+    tpu_ledger.workload("bench").__enter__()
 
     device = str(jax.devices()[0])
     common = {
@@ -211,6 +229,7 @@ def worker():
         "note": "1,024-lane stage; value is a linear projection to "
                 "10,240 lanes, superseded by the full run if it lands",
         "fastsync_block_1k_vals_p50_ms": round(p50_1k * 1e3, 3),
+        "ledger_rollup": ledger_rollup(),
     }
     # The measured stage-1 line goes on record BEFORE the pipelined
     # diagnostic below: its device_put + fresh launches are new chances
@@ -270,6 +289,7 @@ def worker():
         "expanded_valset": True,
         "stage_breakdown": stages,
         "metrics_delta": mdelta,
+        "ledger_rollup": ledger_rollup(),
     }
     _emit(line)
 
@@ -384,6 +404,7 @@ def worker():
             line.get("device_exec_ms_per_launch"),
         "stage_breakdown": stages_structured,
         "metrics_delta": mdelta_structured,
+        "ledger_rollup": ledger_rollup(),
     }
     _emit(line_s)
 
@@ -463,6 +484,7 @@ def worker():
                 "len": len(TRACER),
                 "dropped": TRACER.dropped,
             }
+            line_s["ledger_rollup"] = ledger_rollup()
             _emit(line_s)
         except Exception as e:  # the headline number must survive
             line_s["spec_error"] = repr(e)[:300]

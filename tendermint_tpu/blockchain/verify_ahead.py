@@ -115,7 +115,11 @@ def _window_lane_verdicts(vals, chain_id, lanes_all, sigs_all, per_commit):
         lambda: [c.vote_sign_bytes(chain_id, s)
                  for c, slots in per_commit for s in slots],
     )
-    _, verdicts = vals._batch_verify_lanes(lanes_all, msgs, sigs_all)
+    from ..crypto.tpu import ledger as tpu_ledger
+
+    with tpu_ledger.workload("fastsync"):
+        _, verdicts = vals._batch_verify_lanes(lanes_all, msgs,
+                                               sigs_all)
     return verdicts
 
 

@@ -387,6 +387,39 @@ class MeshConfig:
                 "negative mesh.expanded_shard_crossover_keys")
 
 
+@dataclass
+class CryptoConfig:
+    """Verify-backend intent + launch-ledger sizing (crypto/tpu/
+    {watchdog,ledger}.py; this framework's addition).
+
+    `backend` is the operator's PROMISE, not a dispatch switch: the
+    verify paths keep their own breaker-aware device/host ladder
+    regardless. With "tpu" the silicon watchdog degrades the /status
+    device check whenever the launch ledger shows launches landing on
+    CPU, raising, going silent past the window, or drifting >3x past
+    the recorded silicon exec baseline — the wedged-relay shape that
+    let BENCH_r04/r05 run on TFRT_CPU_0 unnoticed. "auto" (default)
+    and "cpu" report the effective backend but never degrade on it."""
+
+    backend: str = "auto"
+    # effective-backend classification window: how long without a
+    # successful device launch before the watchdog calls the plane
+    # idle/degraded
+    watchdog_window_s: float = 60.0
+    # bounded launch-ledger ring (records, process-global; ~1 KB each)
+    ledger_capacity: int = 512
+
+    def validate_basic(self) -> None:
+        if self.backend not in ("auto", "tpu", "cpu"):
+            raise ValueError(
+                f"unknown crypto.backend {self.backend!r} "
+                "(want auto|tpu|cpu)")
+        if self.watchdog_window_s <= 0:
+            raise ValueError("crypto.watchdog_window_s must be positive")
+        if self.ledger_capacity < 16:
+            raise ValueError("crypto.ledger_capacity must be >= 16")
+
+
 def fast_consensus_config() -> ConsensusConfig:
     """Short timeouts for in-process tests (reference: the 10ms
     timeout-commit test config, config/config.go:867-875)."""
@@ -454,6 +487,7 @@ class Config:
     speculation: SpeculationConfig = field(
         default_factory=SpeculationConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    crypto: CryptoConfig = field(default_factory=CryptoConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig
@@ -471,6 +505,7 @@ class Config:
         self.consensus.validate_basic()
         self.speculation.validate_basic()
         self.mesh.validate_basic()
+        self.crypto.validate_basic()
         self.tx_index.validate_basic()
         self.chaos.validate_basic()
 
@@ -482,8 +517,8 @@ class Config:
         lines = []
         for section_name in ("base", "rpc", "p2p", "mempool", "light",
                              "statesync", "fastsync", "consensus",
-                             "speculation", "mesh", "tx_index",
-                             "instrumentation", "chaos"):
+                             "speculation", "mesh", "crypto",
+                             "tx_index", "instrumentation", "chaos"):
             section = getattr(self, section_name)
             lines.append(f"[{section_name}]")
             for f in dataclasses.fields(section):

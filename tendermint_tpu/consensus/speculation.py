@@ -432,6 +432,8 @@ class SpeculationPlane:
                 raise ValueError(
                     "speculative structured sign-bytes self-check "
                     "failed")
+            from ..crypto.tpu import ledger as tpu_ledger
+
             failpoints.hit("device.verify")
             crypto_metrics().device_launches.inc()
             with tracing.TRACER.span(tracing.SPECULATION_PATCH,
@@ -441,7 +443,8 @@ class SpeculationPlane:
                                  b"".join(s for _, _, s in kept),
                                  np.uint8).reshape(n, 64),
                              patch, split, patch_len, group)
-            out = arena.launch()
+            with tpu_ledger.workload("speculation"):
+                out = arena.launch()
             met.launches.inc(backend="device")
             crypto_metrics().batch_lanes.inc(n, backend="tpu")
             if not out[0]:

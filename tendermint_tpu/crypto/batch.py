@@ -184,12 +184,14 @@ def _ed_probe_triple() -> tuple[bytes, bytes, bytes]:
 
 def _probe_ed25519() -> bool:
     from ..libs import failpoints
+    from .tpu import ledger as tpu_ledger
     from .tpu import verify as tpu_verify
 
     failpoints.hit("device.verify")
     p, m, s = _ed_probe_triple()
-    out = tpu_verify.verify_batch([p] * PROBE_LANES, [m] * PROBE_LANES,
-                                  [s] * PROBE_LANES)
+    with tpu_ledger.workload("probe"):
+        out = tpu_verify.verify_batch(
+            [p] * PROBE_LANES, [m] * PROBE_LANES, [s] * PROBE_LANES)
     # a NaN-ing kernel returns wrong verdicts without raising — a
     # known-answer mismatch is a failed probe, not a closed breaker
     return bool(np.asarray(out).all())
@@ -206,12 +208,14 @@ def _sr_probe_triple() -> tuple[bytes, bytes, bytes]:
 
 def _probe_sr25519() -> bool:
     from ..libs import failpoints
+    from .tpu import ledger as tpu_ledger
     from .tpu import sr_verify
 
     failpoints.hit("device.verify")
     p, m, s = _sr_probe_triple()
-    out = sr_verify.verify_batch_sr([p] * PROBE_LANES, [m] * PROBE_LANES,
-                                    [s] * PROBE_LANES)
+    with tpu_ledger.workload("probe"):
+        out = sr_verify.verify_batch_sr(
+            [p] * PROBE_LANES, [m] * PROBE_LANES, [s] * PROBE_LANES)
     return bool(np.asarray(out).all())
 
 

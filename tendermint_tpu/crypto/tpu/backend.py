@@ -1,0 +1,89 @@
+"""One backend-classification vocabulary for the whole repo.
+
+Round 5's lesson (BENCH_r05, ROADMAP item 2c): r04/r05 silently ran on
+TFRT_CPU_0 and nothing in the process could say so. The fix grew three
+near-copies of "is this device string silicon?" — bench.py's backend
+stamp, tools/silicon_record.record_if_tpu, tools/bench_trend.py's
+misrepresentation check — and the launch-ledger watchdog would have
+been a fourth. This module is the single source all of them import
+(pure string logic; no jax, importable from tools/ scripts and the
+product alike).
+
+Vocabulary:
+  * ``backend_label(device)`` — the stamp written into BENCH lines and
+    silicon records: ``"tpu"`` or ``"cpu-fallback"`` (hyphen; the
+    historical silicon-record spelling, kept stable for the recorded
+    rounds already on disk).
+  * ``classify_stamps(...)`` — the trajectory-gate classifier:
+    ``"silicon"`` / ``"cpu_fallback"`` (underscore; the bench_trend
+    table vocabulary) plus the misrepresentation/unattribution
+    problems.
+  * ``effective_backend_states()`` — the watchdog's closed state set.
+"""
+
+from __future__ import annotations
+
+# Substrings that mark a jax device string as host silicon-less
+# execution (TFRT_CPU_0, "cpu:0", "host").
+CPU_DEVICE_MARKERS = ("cpu", "host")
+# Backend stamps that claim real accelerator silicon.
+SILICON_BACKENDS = ("tpu", "silicon", "device")
+
+# The watchdog's effective-backend classification (closed set; the
+# tpu_effective_backend gauge is one-hot over exactly these):
+#   tpu          — a successful launch landed on accelerator silicon
+#                  within the window
+#   cpu_fallback — launches are completing on CPU (or raising and
+#                  degrading to host) with no silicon success in the
+#                  window
+#   idle         — records exist, but none within the window
+#   unknown      — no device launch has ever been recorded
+EFFECTIVE_STATES = ("tpu", "cpu_fallback", "idle", "unknown")
+
+
+def device_is_cpu(device: str) -> bool:
+    d = str(device).lower()
+    return any(m in d for m in CPU_DEVICE_MARKERS)
+
+
+def backend_label(device: str) -> str:
+    """Device string -> the backend stamp bench.py / silicon records
+    carry ("tpu" or "cpu-fallback")."""
+    return "tpu" if "tpu" in str(device).lower() else "cpu-fallback"
+
+
+def effective_state_of(device: str) -> str:
+    """Device string of a completed launch -> the watchdog state it
+    evidences ("tpu" or "cpu_fallback")."""
+    return "tpu" if backend_label(device) == "tpu" else "cpu_fallback"
+
+
+def classify_stamps(backend_stamp: str, cpu_fallback: bool,
+                    device: str) -> tuple[str, list[str]]:
+    """The trajectory-gate core (tools/bench_trend.py): a parsed BENCH
+    payload's explicit stamps -> (``"silicon"`` | ``"cpu_fallback"``,
+    problems). A silicon backend stamp contradicted by the fallback
+    flag or a CPU device string is ``misrepresented``; a measured value
+    with no stamps at all is ``unattributed`` — neither may extend the
+    silicon trajectory."""
+    problems: list[str] = []
+    stamp = str(backend_stamp or "").lower()
+    device = str(device or "")
+    if stamp:
+        claims_silicon = any(b in stamp for b in SILICON_BACKENDS) \
+            and "cpu" not in stamp
+        if claims_silicon and (cpu_fallback or device_is_cpu(device)):
+            problems.append(
+                f"misrepresented: backend stamp {stamp!r} but "
+                f"cpu_fallback={cpu_fallback} device={device!r}")
+            return "cpu_fallback", problems
+        return ("silicon" if claims_silicon else "cpu_fallback"), problems
+    if cpu_fallback or (device and device_is_cpu(device)):
+        return "cpu_fallback", problems
+    if device:
+        return "silicon", problems
+    # a measured value with no device/backend evidence at all cannot
+    # claim the silicon trajectory
+    problems.append(
+        "unattributed: measured value with no device/backend stamp")
+    return "cpu_fallback", problems

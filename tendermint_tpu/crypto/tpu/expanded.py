@@ -46,6 +46,7 @@ from collections import OrderedDict
 
 import numpy as np
 
+from . import ledger as _ledger
 from . import verify as tv
 from ...libs import tracing
 
@@ -493,6 +494,28 @@ class ExpandedKeys:
         # Pubkey bytes device-resident beside the tables: verify
         # launches send (N,) indices instead of (N, 32) pubkey rows.
         self.akeys = akeys
+        self._register_hbm()
+
+    def _register_hbm(self) -> None:
+        """Device-resident comb tables + key rows claim their bytes in
+        the HBM accounting registry (ledger.register_hbm): replicated
+        tables cost the FULL table on every chip; key-range-sharded
+        builds one range block per chip."""
+        try:
+            nbytes = int(self.tables.nbytes) + int(self.akeys.nbytes) \
+                + int(self.key_ok.nbytes)
+            if self.sharded:
+                per = nbytes // max(self.n_shards, 1)
+                for d in list(self.mesh.devices.flat):
+                    _ledger.register_hbm("table_shard", str(d), per)
+            elif self.mesh is not None:
+                for d in list(self.mesh.devices.flat):
+                    _ledger.register_hbm("comb_tables", str(d), nbytes)
+            else:
+                _ledger.register_hbm(
+                    "comb_tables", _ledger.default_device_str(), nbytes)
+        except Exception:  # pragma: no cover - accounting never fatal
+            pass
 
     def _build_tables(self, a_raw: np.ndarray, device=None):
         """Chunked comb-table build: (V, 32) pubkey rows ->
@@ -584,6 +607,7 @@ class ExpandedKeys:
         self.sharded = True
         self.n_shards = d_n
         self.keys_per_shard = k
+        self._register_hbm()
         try:
             from ...libs.metrics import tpu_metrics
 
@@ -798,7 +822,8 @@ class ExpandedKeys:
         copy) children — the stage taxonomy BENCH's stage_breakdown
         and /debug/trace report. `prepare` returns (launch_args,
         well_formed); `launch(*launch_args)` returns the device
-        verdict array."""
+        verdict array. One launch-ledger record per call, its stages
+        timed around the same blocks the spans bracket."""
         from ...libs.metrics import tpu_metrics
 
         if not self.sharded:
@@ -806,16 +831,31 @@ class ExpandedKeys:
             # the per-device routed bucket it actually executes
             tpu_metrics().batch_occupancy.observe(n / self._bucket(n))
         t = tracing.TRACER
-        with t.span(tracing.CRYPTO_VERIFY, lanes=n, backend=backend):
-            with t.span(tracing.CRYPTO_PACK, lanes=n):
+        kernel = backend + ("_sharded" if self.sharded else "")
+        with _ledger.launch(kernel) as rec, \
+                t.span(tracing.CRYPTO_VERIFY, lanes=n, backend=backend):
+            rec.lanes = n
+            with rec.stage("pack"), t.span(tracing.CRYPTO_PACK, lanes=n):
                 launch_args, well_formed = prepare()
-            with t.span(tracing.CRYPTO_DISPATCH, lanes=n):
+            rec.bytes_h2d = _ledger.nbytes_of(launch_args)
+            with rec.stage("dispatch"), \
+                    t.span(tracing.CRYPTO_DISPATCH, lanes=n):
                 out = launch(*launch_args)
             if hasattr(out, "block_until_ready"):
-                with t.span(tracing.CRYPTO_DEVICE_EXEC, lanes=n):
+                with rec.stage("exec"), \
+                        t.span(tracing.CRYPTO_DEVICE_EXEC, lanes=n):
                     out.block_until_ready()
-            with t.span(tracing.CRYPTO_READBACK, lanes=n):
-                return np.asarray(out)[:n] & well_formed
+            with rec.stage("readback"), \
+                    t.span(tracing.CRYPTO_READBACK, lanes=n):
+                full = np.asarray(out)
+            rec.result(out)
+            rec.capacity = int(full.shape[0])
+            rec.bytes_d2h = int(full.nbytes)
+            if self.sharded:
+                rec.n_devices = self.n_shards
+            res = full[:n] & well_formed
+            rec.verdicts(res)
+            return res
 
     # -- structured commit path (message bytes assembled on device) --
 

@@ -46,6 +46,7 @@ import functools
 
 import numpy as np
 
+from . import ledger as _ledger
 from . import verify as tv
 from .expanded import ExpandedKeys, assemble_core
 from ...types.sign_batch import PATCH_W
@@ -223,6 +224,12 @@ class ResidentArena:
         self.pre[0, :len(smsg)] = np.frombuffer(smsg, np.uint8)
         self.pre_len[0] = len(smsg)
         self.reupload_bytes = 0
+        # launch-ledger accounting: bytes staged since the last launch
+        # (splice deltas + templates) and a host-side active-lane
+        # estimate (exact when splice slots are distinct, the
+        # SpeculationPlane's usage)
+        self._pending_upload = 0
+        self._active_lanes = 1
         self._set_arena_gauge()
 
     # -- sizes / metrics ----------------------------------------------
@@ -243,9 +250,15 @@ class ResidentArena:
             speculation_metrics().arena_bytes.set(self.arena_bytes())
         except Exception:  # pragma: no cover - metrics never fatal
             pass
+        try:
+            _ledger.register_hbm("arena", _ledger.default_device_str(),
+                                 self.arena_bytes())
+        except Exception:  # pragma: no cover - accounting never fatal
+            pass
 
     def _count_reupload(self, nbytes: int) -> None:
         self.reupload_bytes += nbytes
+        self._pending_upload += nbytes
         try:
             from ...libs.metrics import speculation_metrics
 
@@ -284,6 +297,7 @@ class ResidentArena:
         """New height: every lane but the sentinel goes inactive; the
         buffers themselves stay resident for the next splices."""
         self._active = _clear_fn()(self._active)
+        self._active_lanes = 1
 
     # -- the steady-state hot path ------------------------------------
 
@@ -313,6 +327,7 @@ class ResidentArena:
             np.asarray(patch_len, np.int32).reshape(k),
             np.asarray(group, np.int32).reshape(k))]
         self._count_reupload(sum(int(a.nbytes) for a in args))
+        self._active_lanes = min(self.capacity, self._active_lanes + k)
         (self._sb, self._s_ok, self._patch, self._split,
          self._patch_len, self._group, self._active) = _splice_fn()(
             self._sb, self._s_ok, self._patch, self._split,
@@ -325,16 +340,33 @@ class ResidentArena:
         travel host->device. Returns (capacity,) verdicts — inactive
         lanes read False; callers check verdict[0] (the sentinel)
         before trusting the rest."""
-        tv.count_compile("resident", (self.capacity, self.width))
-        self._count_reupload(
-            int(self.pre.nbytes + self.suf.nbytes
-                + self.pre_len.nbytes + self.suf_len.nbytes))
-        out = _arena_kernel(self.width)(
-            self._ab, self._sb, self._s_ok, self._active,
-            self.pre, self.pre_len, self.suf, self.suf_len,
-            self._patch, self._split, self._patch_len, self._group,
-            tv.b_comb_tables())
-        return np.asarray(out)
+        with _ledger.launch("resident") as rec:
+            rec.lanes = self._active_lanes
+            rec.capacity = self.capacity
+            rec.compile_hit = tv.count_compile(
+                "resident", (self.capacity, self.width))
+            self._count_reupload(
+                int(self.pre.nbytes + self.suf.nbytes
+                    + self.pre_len.nbytes + self.suf_len.nbytes))
+            # delta accounting: only what splices + templates staged
+            # since the last launch travelled H2D — the arena's point
+            rec.bytes_h2d = self._pending_upload
+            self._pending_upload = 0
+            with rec.stage("dispatch"):
+                out = _arena_kernel(self.width)(
+                    self._ab, self._sb, self._s_ok, self._active,
+                    self.pre, self.pre_len, self.suf, self.suf_len,
+                    self._patch, self._split, self._patch_len,
+                    self._group, tv.b_comb_tables())
+            with rec.stage("exec"):
+                getattr(out, "block_until_ready", lambda: None)()
+            with rec.stage("readback"):
+                res = np.asarray(out)
+            rec.result(out)
+            rec.bytes_d2h = int(res.nbytes)
+            rec.ok_lanes = int(res.sum())
+            rec.verdict = "ok" if bool(res[0]) else "sentinel_failed"
+        return res
 
     # -- introspection (tests pin donation with these) -----------------
 
@@ -437,11 +469,19 @@ class MeshResidentArena:
         self.pre_len[0] = len(smsg)
         self.reupload_bytes = 0
         self._shard_reupload = [0] * d_n
+        self._pending_upload = 0
+        self._active_lanes = d_n  # one sentinel per shard
         try:
             from ...libs.metrics import speculation_metrics
 
             speculation_metrics().arena_bytes.set(self.arena_bytes())
         except Exception:  # pragma: no cover - metrics never fatal
+            pass
+        try:
+            per_bytes = self.arena_bytes() // d_n
+            for dev in self.devices:
+                _ledger.register_hbm("arena_shard", str(dev), per_bytes)
+        except Exception:  # pragma: no cover - accounting never fatal
             pass
 
     # -- sizes / metrics ----------------------------------------------
@@ -456,6 +496,7 @@ class MeshResidentArena:
     def _count_reupload(self, per_device: int) -> None:
         """`per_device` bytes went to EACH device this operation."""
         self.reupload_bytes += per_device * self.n_shards
+        self._pending_upload += per_device * self.n_shards
         for d in range(self.n_shards):
             self._shard_reupload[d] += per_device
         try:
@@ -509,6 +550,7 @@ class MeshResidentArena:
         """New height: every lane but the per-shard sentinels goes
         inactive; buffers stay resident for the next splices."""
         self._active = _mesh_clear_fn()(self._active)
+        self._active_lanes = self.n_shards
 
     # -- the steady-state hot path ------------------------------------
 
@@ -573,6 +615,8 @@ class MeshResidentArena:
             pos, v_sb, v_sok, v_patch, v_split, v_plen,
             v_group)) // d_n
         self._count_reupload(per_dev)
+        self._active_lanes = min(self.capacity + d_n - 1,
+                                 self._active_lanes + k)
         sh = self._sh
         import jax
 
@@ -591,24 +635,40 @@ class MeshResidentArena:
         `sentinel_ok` holds each shard's known-answer result for
         per-device attribution. Slot 0 of the returned array is the
         conjunction of every shard sentinel."""
-        tv.count_compile("resident_mesh",
-                         (self.n_shards, self.shard_capacity,
-                          self.width))
-        self._count_reupload(
-            int(self.pre.nbytes + self.suf.nbytes
-                + self.pre_len.nbytes + self.suf_len.nbytes))
-        out = _mesh_arena_kernel(self.width)(
-            self._ab, self._sb, self._s_ok, self._active,
-            self.pre, self.pre_len, self.suf, self.suf_len,
-            self._patch, self._split, self._patch_len, self._group,
-            tv.b_comb_tables())
-        o = np.asarray(out)  # (D, per)
         d_n = self.n_shards
-        self.sentinel_ok = [bool(o[d, 0]) for d in range(d_n)]
-        verd = np.zeros(self.capacity, bool)
-        verd[0] = all(self.sentinel_ok)
-        for d in range(d_n):
-            verd[1 + d::d_n] = o[d, 1:]
+        with _ledger.launch("resident_mesh") as rec:
+            rec.lanes = self._active_lanes
+            rec.capacity = 1 + d_n * (self.shard_capacity - 1)
+            rec.n_devices = d_n
+            rec.shard_lanes = [self.shard_capacity] * d_n
+            rec.compile_hit = tv.count_compile(
+                "resident_mesh",
+                (d_n, self.shard_capacity, self.width))
+            self._count_reupload(
+                int(self.pre.nbytes + self.suf.nbytes
+                    + self.pre_len.nbytes + self.suf_len.nbytes))
+            rec.bytes_h2d = self._pending_upload
+            self._pending_upload = 0
+            with rec.stage("dispatch"):
+                out = _mesh_arena_kernel(self.width)(
+                    self._ab, self._sb, self._s_ok, self._active,
+                    self.pre, self.pre_len, self.suf, self.suf_len,
+                    self._patch, self._split, self._patch_len,
+                    self._group, tv.b_comb_tables())
+            with rec.stage("exec"):
+                getattr(out, "block_until_ready", lambda: None)()
+            with rec.stage("readback"):
+                o = np.asarray(out)  # (D, per)
+            rec.result(out)
+            rec.bytes_d2h = int(o.nbytes)
+            self.sentinel_ok = [bool(o[d, 0]) for d in range(d_n)]
+            verd = np.zeros(self.capacity, bool)
+            verd[0] = all(self.sentinel_ok)
+            for d in range(d_n):
+                verd[1 + d::d_n] = o[d, 1:]
+            rec.ok_lanes = int(verd.sum())
+            rec.verdict = ("ok" if all(self.sentinel_ok)
+                           else "sentinel_failed")
         try:
             from ...libs.metrics import tpu_metrics
 

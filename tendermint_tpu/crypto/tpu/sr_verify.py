@@ -25,6 +25,7 @@ import functools
 import numpy as np
 
 from .. import ed25519_ref as ref
+from . import ledger as _ledger
 from . import verify as tv
 
 _L = ref.L
@@ -128,84 +129,112 @@ def verify_batch_sr(pubs, msgs, sigs, ctx: bytes = b"",
     if n == 0:
         return np.zeros(0, bool)
 
-    well_formed = np.fromiter(
-        ((len(p) == 32 and len(s) == 64 and (s[63] & 0x80) != 0)
-         for p, s in zip(pubs, sigs)),
-        bool, count=n)
-    safe_sigs = [
-        s if ok else b"\0" * 63 + b"\x80"
-        for s, ok in zip(sigs, well_formed)
-    ]
-    safe_pubs = [p if ok else b"\0" * 32
-                 for p, ok in zip(pubs, well_formed)]
+    with _ledger.launch("sr25519_cpu" if cpu else "sr25519") as rec:
+        rec.lanes = n
+        with rec.stage("pack"):
+            well_formed = np.fromiter(
+                ((len(p) == 32 and len(s) == 64 and (s[63] & 0x80) != 0)
+                 for p, s in zip(pubs, sigs)),
+                bool, count=n)
+            safe_sigs = [
+                s if ok else b"\0" * 63 + b"\x80"
+                for s, ok in zip(sigs, well_formed)
+            ]
+            safe_pubs = [p if ok else b"\0" * 32
+                         for p, ok in zip(pubs, well_formed)]
 
-    a_raw = np.frombuffer(b"".join(safe_pubs), np.uint8).reshape(n, 32)
-    sig_raw = np.frombuffer(b"".join(safe_sigs), np.uint8).reshape(n, 64)
-    r_raw = np.ascontiguousarray(sig_raw[:, :32])
-    s_raw = np.ascontiguousarray(sig_raw[:, 32:])
-    s_raw[:, 31] &= 0x7F  # strip schnorrkel marker bit
+            a_raw = np.frombuffer(
+                b"".join(safe_pubs), np.uint8).reshape(n, 32)
+            sig_raw = np.frombuffer(
+                b"".join(safe_sigs), np.uint8).reshape(n, 64)
+            r_raw = np.ascontiguousarray(sig_raw[:, :32])
+            s_raw = np.ascontiguousarray(sig_raw[:, 32:])
+            s_raw[:, 31] &= 0x7F  # strip schnorrkel marker bit
 
-    # Host preconditions: s < L; A/R canonical (< p) and non-negative.
-    s_ok = _lt_words(s_raw, _L_WORDS)
-    a_pre = _lt_words(a_raw, _P_WORDS) & ((a_raw[:, 0] & 1) == 0)
-    r_pre = _lt_words(r_raw, _P_WORDS) & ((r_raw[:, 0] & 1) == 0)
+            # Host preconditions: s < L; A/R canonical (< p) and
+            # non-negative.
+            s_ok = _lt_words(s_raw, _L_WORDS)
+            a_pre = _lt_words(a_raw, _P_WORDS) & ((a_raw[:, 0] & 1) == 0)
+            r_pre = _lt_words(r_raw, _P_WORDS) & ((r_raw[:, 0] & 1) == 0)
 
-    # Merlin challenges (SIMD host; transcript sees the WIRE bytes of
-    # pk and R, marker included on neither — R is sig[:32] as-is).
-    ks = sr25519_challenges(a_raw, list(msgs), r_raw, ctx)
-    kdig = _nibbles(ks, n)
-    s_ints = [int.from_bytes(s_raw[i].tobytes(), "little") for i in range(n)]
-    sdig = _nibbles(s_ints, n)
+            # Merlin challenges (SIMD host; transcript sees the WIRE
+            # bytes of pk and R, marker included on neither — R is
+            # sig[:32] as-is).
+            ks = sr25519_challenges(a_raw, list(msgs), r_raw, ctx)
+            kdig = _nibbles(ks, n)
+            s_ints = [int.from_bytes(s_raw[i].tobytes(), "little")
+                      for i in range(n)]
+            sdig = _nibbles(s_ints, n)
 
-    # Bucket like the ed25519 path: powers of two up to 1024, then
-    # multiples of 1024 (a 10,240-lane batch pads 0% instead of 60%).
-    if n <= 1024:
-        bucket = tv._MIN_BATCH
-        while bucket < n:
-            bucket <<= 1
-    else:
-        bucket = (n + 1023) // 1024 * 1024
-    mesh = None if cpu else tv._mesh()
-    shard = mesh is not None and bucket >= tv._SHARD_MIN
-    if shard:
-        # Odd buckets pad up to a device multiple (inert zero lanes)
-        # instead of forfeiting the mesh — same contract as the
-        # ed25519 paths (verify.mesh_lane_pad).
-        bucket = tv.mesh_lane_pad(bucket, mesh)
-    pad = bucket - n
-    if pad:
-        a_raw = np.pad(a_raw, ((0, pad), (0, 0)))
-        r_raw = np.pad(r_raw, ((0, pad), (0, 0)))
-        kdig = np.pad(kdig, ((0, 0), (0, pad)))
-        sdig = np.pad(sdig, ((0, 0), (0, pad)))
-        s_ok = np.pad(s_ok, (0, pad))
-        a_pre = np.pad(a_pre, (0, pad))
-        r_pre = np.pad(r_pre, (0, pad))
-
-    btab = tv.b_comb_tables()[:_WINDOWS]
-    args = dict(ab=a_raw, rb=r_raw, kdig=kdig, sdig=sdig,
-                a_pre=a_pre, r_pre=r_pre, s_ok=s_ok)
-    if cpu:
-        import jax
-
-        with jax.default_device(jax.local_devices(backend="cpu")[0]):
-            out = _kernel()(btab=btab, **args)
-        return np.asarray(out)[:n] & well_formed
-    if shard:
-        import jax
-
-        row_s, vec_s, repl_s = tv._shardings(mesh)
-        for key, v in args.items():
-            if v.ndim == 1:
-                args[key] = jax.device_put(v, vec_s)
-            elif key in ("kdig", "sdig"):
-                from jax.sharding import NamedSharding, PartitionSpec
-
-                args[key] = jax.device_put(
-                    v, NamedSharding(mesh, PartitionSpec(None, "dp")))
+            # Bucket like the ed25519 path: powers of two up to 1024,
+            # then multiples of 1024 (a 10,240-lane batch pads 0%
+            # instead of 60%).
+            if n <= 1024:
+                bucket = tv._MIN_BATCH
+                while bucket < n:
+                    bucket <<= 1
             else:
-                args[key] = jax.device_put(v, row_s)
-        btab = jax.device_put(btab, repl_s)
-        tv.count_shard_lanes(mesh, bucket)
-    out = _kernel()(btab=btab, **args)
-    return np.asarray(out)[:n] & well_formed
+                bucket = (n + 1023) // 1024 * 1024
+            mesh = None if cpu else tv._mesh()
+            shard = mesh is not None and bucket >= tv._SHARD_MIN
+            if shard:
+                # Odd buckets pad up to a device multiple (inert zero
+                # lanes) instead of forfeiting the mesh — same contract
+                # as the ed25519 paths (verify.mesh_lane_pad).
+                bucket = tv.mesh_lane_pad(bucket, mesh)
+            pad = bucket - n
+            if pad:
+                a_raw = np.pad(a_raw, ((0, pad), (0, 0)))
+                r_raw = np.pad(r_raw, ((0, pad), (0, 0)))
+                kdig = np.pad(kdig, ((0, 0), (0, pad)))
+                sdig = np.pad(sdig, ((0, 0), (0, pad)))
+                s_ok = np.pad(s_ok, (0, pad))
+                a_pre = np.pad(a_pre, (0, pad))
+                r_pre = np.pad(r_pre, (0, pad))
+
+            btab = tv.b_comb_tables()[:_WINDOWS]
+            args = dict(ab=a_raw, rb=r_raw, kdig=kdig, sdig=sdig,
+                        a_pre=a_pre, r_pre=r_pre, s_ok=s_ok)
+        rec.capacity = bucket
+        rec.compile_hit = tv.count_compile(
+            "sr25519_cpu" if cpu else "sr25519", (bucket, int(cpu)))
+        rec.bytes_h2d = _ledger.nbytes_of(args) + int(btab.nbytes)
+        with rec.stage("dispatch"):
+            if cpu:
+                import jax
+
+                with jax.default_device(
+                        jax.local_devices(backend="cpu")[0]):
+                    out = _kernel()(btab=btab, **args)
+            else:
+                if shard:
+                    import jax
+
+                    row_s, vec_s, repl_s = tv._shardings(mesh)
+                    for key, v in args.items():
+                        if v.ndim == 1:
+                            args[key] = jax.device_put(v, vec_s)
+                        elif key in ("kdig", "sdig"):
+                            from jax.sharding import (NamedSharding,
+                                                      PartitionSpec)
+
+                            args[key] = jax.device_put(
+                                v, NamedSharding(
+                                    mesh, PartitionSpec(None, "dp")))
+                        else:
+                            args[key] = jax.device_put(v, row_s)
+                    btab = jax.device_put(btab, repl_s)
+                    tv.count_shard_lanes(mesh, bucket)
+                    d = int(mesh.devices.size)
+                    rec.n_devices = d
+                    rec.shard_lanes = [bucket // d] * d
+                out = _kernel()(btab=btab, **args)
+        with rec.stage("exec"):
+            getattr(out, "block_until_ready", lambda: None)()
+        with rec.stage("readback"):
+            full = np.asarray(out)
+        rec.result(out)
+        rec.bytes_d2h = int(full.nbytes)
+        res = full[:n] & well_formed
+        rec.verdicts(res)
+    return res

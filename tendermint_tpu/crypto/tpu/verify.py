@@ -32,6 +32,7 @@ import hashlib
 import numpy as np
 
 from .. import ed25519_ref as ref
+from . import ledger as _ledger
 from ...libs import tracing
 
 # Warm the native packer at import (node/verifier startup): the
@@ -383,20 +384,37 @@ def verify_batch(pubs, msgs, sigs) -> np.ndarray:
     with t.span(tracing.CRYPTO_VERIFY, lanes=n, backend="general"):
         for size in sizes:
             end = min(start + size, n)
-            pending.append(
-                (start, end, _launch_chunk(pubs[start:end], msgs[start:end],
-                                           sigs[start:end], size))
-            )
+            rec = _ledger.begin("general")
+            rec.lanes = end - start
+            try:
+                fut = _launch_chunk(pubs[start:end], msgs[start:end],
+                                    sigs[start:end], size, rec=rec)
+            except Exception as exc:
+                rec.fail(exc)
+                raise
+            pending.append((start, end, fut, rec))
             start = end
-        for s, e, fut in pending:
+        for s, e, fut, rec in pending:
             # device_exec = wait for the async launch's verdicts to be
             # ready on device; readback = the D2H verdict copy. The
             # split is what lets BENCH tell chip time from wire/host.
-            if hasattr(fut, "block_until_ready"):
-                with t.span(tracing.CRYPTO_DEVICE_EXEC, lanes=e - s):
-                    fut.block_until_ready()
-            with t.span(tracing.CRYPTO_READBACK, lanes=e - s):
-                out[s:e] = np.asarray(fut)[: e - s]
+            try:
+                if hasattr(fut, "block_until_ready"):
+                    with rec.stage("exec"), \
+                            t.span(tracing.CRYPTO_DEVICE_EXEC,
+                                   lanes=e - s):
+                        fut.block_until_ready()
+                with rec.stage("readback"), \
+                        t.span(tracing.CRYPTO_READBACK, lanes=e - s):
+                    chunk = np.asarray(fut)
+                    out[s:e] = chunk[: e - s]
+            except Exception as exc:
+                rec.fail(exc)
+                raise
+            rec.result(fut)
+            rec.bytes_d2h = int(chunk.nbytes)
+            rec.verdicts(out[s:e])
+            rec.done()
     return out & well_formed
 
 
@@ -408,20 +426,28 @@ def verify_batch(pubs, msgs, sigs) -> np.ndarray:
 _COMPILED_SHAPES: set[tuple] = set()
 
 
-def count_compile(kernel: str, shape: tuple) -> None:
+def count_compile(kernel: str, shape: tuple) -> bool:
+    """Returns True when this (kernel, shape) was already launched —
+    the launch ledger's compile_cache hit/miss field — and counts the
+    miss into tpu_jit_compiles_total."""
     key = (kernel,) + shape
     if key in _COMPILED_SHAPES:
-        return
+        return True
     _COMPILED_SHAPES.add(key)
     from ...libs.metrics import tpu_metrics
 
     tpu_metrics().jit_compiles.inc(kernel=kernel)
+    return False
 
 
-def _launch_chunk(pubs, msgs, sigs, bucket: int):
+def _launch_chunk(pubs, msgs, sigs, bucket: int, rec=None):
     """Dispatch one bucket-sized kernel launch; returns the device array
     (async — caller materializes). Padding lanes use a fixed valid
-    triple so they cannot affect real lanes."""
+    triple so they cannot affect real lanes. `rec` is the caller's
+    launch-ledger record; pack/dispatch timing lands on the same
+    blocks the spans already bracket."""
+    import contextlib
+
     n = len(pubs)
     t = tracing.TRACER
     mesh = _mesh()
@@ -431,7 +457,12 @@ def _launch_chunk(pubs, msgs, sigs, bucket: int):
         # the same inert dummy triple) instead of dropping to a single
         # device — a 10,001-lane batch must not forfeit the mesh.
         bucket = mesh_lane_pad(bucket, mesh)
-    with t.span(tracing.CRYPTO_PACK, lanes=bucket):
+
+    def stage(name):
+        return rec.stage(name) if rec is not None \
+            else contextlib.nullcontext()
+
+    with stage("pack"), t.span(tracing.CRYPTO_PACK, lanes=bucket):
         if bucket > n:
             dp, dm, ds = _dummy_triple()
             pad = bucket - n
@@ -439,8 +470,17 @@ def _launch_chunk(pubs, msgs, sigs, bucket: int):
             msgs = list(msgs) + [dm] * pad
             sigs = list(sigs) + [ds] * pad
         packed = pack_batch(pubs, msgs, sigs)
-    count_compile("general", (bucket, packed["msg"].shape[1]))
-    with t.span(tracing.CRYPTO_DISPATCH, lanes=bucket):
+    hit = count_compile("general", (bucket, packed["msg"].shape[1]))
+    if rec is not None:
+        rec.capacity = bucket
+        rec.compile_hit = hit
+        rec.bytes_h2d = _ledger.nbytes_of(packed) + \
+            int(b_comb_tables().nbytes)
+        if shard:
+            d = int(mesh.devices.size)
+            rec.n_devices = d
+            rec.shard_lanes = [bucket // d] * d
+    with stage("dispatch"), t.span(tracing.CRYPTO_DISPATCH, lanes=bucket):
         btab = b_comb_tables()
         if shard:
             import jax

@@ -617,6 +617,33 @@ class Runner:
             return None
         return forensics.timeline_summary(timelines)
 
+    async def collect_launch_ledger(self) -> dict | None:
+        """Per-node launch-ledger rollups over the live net
+        (best-effort, like collect_timeline): {node label: rollup}
+        from each debug server's /debug/launches, None when nothing
+        answered or every ledger is empty. tools/launch_ledger.py
+        reads the resulting report block directly."""
+        import json
+
+        out: dict[str, dict] = {}
+        for n in self.nodes:
+            if not n.pprof_port or n.proc is None \
+                    or n.proc.poll() is not None:
+                continue
+            try:
+                doc = json.loads(await self._debug_get(
+                    n, "/debug/launches"))
+            except Exception:
+                continue
+            roll = doc.get("rollup") or {}
+            if roll.get("records"):
+                out[f"node{n.index}"] = {
+                    "rollup": roll,
+                    "watchdog": doc.get("watchdog"),
+                    "hbm": doc.get("hbm"),
+                }
+        return out or None
+
     @staticmethod
     def _sum_metric(metrics_text: str, name: str) -> float:
         """Sum every sample of a counter/gauge family in Prometheus
@@ -1302,6 +1329,13 @@ class Runner:
                 timeline = None
             if timeline is not None:
                 report["timeline"] = timeline
+            try:
+                ledger = await self.collect_launch_ledger()
+            except Exception as e:  # attribution never fails the run
+                self.log(f"launch-ledger collection failed: {e!r}")
+                ledger = None
+            if ledger is not None:
+                report["launch_ledger"] = ledger
             return report
         finally:
             self.stop_load()

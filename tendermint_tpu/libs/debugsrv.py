@@ -20,6 +20,10 @@ Reference: node/node.go:807-812 serves net/http/pprof on
                                windows to the trailing N s (default:
                                the whole ring)
   GET /debug/trace/rollup      per-span-kind p50/p95/p99 rollup JSON
+  GET /debug/launches?workload=W&seconds=N
+                               device launch-ledger records + per-
+                               workload rollup + watchdog classification
+                               + HBM registry (crypto/tpu/ledger.py)
   GET /metrics                 Prometheus text exposition (full
                                per-module catalog, materialized on
                                scrape)
@@ -213,18 +217,38 @@ class HealthMonitor:
                     logger.exception("speculation status check failed")
 
         # -- device: is the accelerator serving, and is the verify
-        # queue draining? Per-backend circuit-breaker states: ed25519
-        # and sr25519 degrade independently. --
+        # queue draining? Per-backend circuit-breaker states (ed25519
+        # and sr25519 degrade independently) MERGED with the silicon
+        # watchdog's launch-ledger verdict: configured-vs-effective
+        # backend, last successful device launch age, exec-p50 drift
+        # and HBM budget (crypto/tpu/watchdog.py). Either source
+        # degrades the check; the reason string names which. --
         states = cbatch.breaker_states()
         qdepth = int(tpu_metrics().verify_queue_depth.value())
         dv: dict = {"queue_depth": qdepth, "breakers": states}
         broken = sorted(b for b, s in states.items() if s != "closed")
-        if not broken:
+        reasons = []
+        if broken:
+            reasons.append("breaker open ({}): verifying on host"
+                           .format(", ".join(broken)))
+        try:
+            from ..crypto.tpu import watchdog as _watchdog
+
+            wd = _watchdog.verdict()
+            dv["effective_backend"] = wd["effective_backend"]
+            dv["configured_backend"] = wd["configured_backend"]
+            dv["last_device_launch_age_s"] = \
+                wd["last_device_launch_age_s"]
+            dv["launches_in_window"] = wd["launches_in_window"]
+            if wd["status"] != "ok":
+                reasons.append(wd["reason"])
+        except Exception:  # pragma: no cover - monitoring guard
+            logger.exception("silicon watchdog verdict failed")
+        if not reasons:
             dv["status"] = "ok"
         else:
             dv["status"] = "degraded"
-            dv["detail"] = ("breaker open ({}): verifying on host"
-                            .format(", ".join(broken)))
+            dv["detail"] = "; ".join(reasons)
         checks["device"] = dv
 
         # -- overload: the backpressure controller's aggregate view
@@ -441,6 +465,7 @@ class DebugServer:
             return (b"pprof endpoints: goroutine, heap?seconds=N, "
                     b"profile?seconds=N; also /metrics, /status, "
                     b"/debug/trace?seconds=N, /debug/trace/rollup, "
+                    b"/debug/launches?workload=W&seconds=N, "
                     b"/debug/failpoint (GET state / POST arm)\n")
         if path == "/debug/failpoint":
             return self._failpoint_route(method, body)
@@ -518,6 +543,31 @@ class DebugServer:
                 "capacity": TRACER.capacity,
                 "spans_dropped": TRACER.dropped,
             }).encode(), b"application/json")
+        if path == "/debug/launches":
+            import json
+
+            from ..crypto.tpu import ledger as tpu_ledger
+            from ..crypto.tpu import watchdog as tpu_watchdog
+
+            wl = params.get("workload") or None
+            secs = _parse_seconds(params.get("seconds"), 0.0,
+                                  cap=86400.0)
+
+            def render() -> bytes:
+                recs = tpu_ledger.snapshot(workload=wl,
+                                           seconds=secs or None)
+                return json.dumps({
+                    "records": recs,
+                    "rollup": tpu_ledger.rollup(recs),
+                    "watchdog": tpu_watchdog.classify(),
+                    "hbm": tpu_ledger.hbm_snapshot(),
+                }).encode()
+
+            # a full 512-record ring renders to ~500 KB of JSON — off
+            # the event loop, like /debug/trace
+            body = await asyncio.get_running_loop().run_in_executor(
+                None, render)
+            return body, b"application/json"
         if path == "/metrics":
             from .metrics import DEFAULT, node_metrics
 

@@ -691,6 +691,24 @@ class TPUMetrics:
         "table_shard_bytes",
         "Per-device bytes of the newest key-range-sharded expanded "
         "comb table (0 until a sharded build runs).", "tpu"))
+    effective_backend: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "effective_backend",
+        "One-hot effective verify backend classified from the launch "
+        "ledger by the silicon watchdog, by backend state.", "tpu"))
+    launch_ledger_records: Counter = field(
+        default_factory=lambda: DEFAULT.counter(
+            "launch_ledger_records_total",
+            "Device launch-ledger records appended, by workload and "
+            "backend.", "tpu"))
+    launch_ledger_evictions: Counter = field(
+        default_factory=lambda: DEFAULT.counter(
+            "launch_ledger_evictions_total",
+            "Launch-ledger records evicted from the bounded ring.",
+            "tpu"))
+    hbm_resident_bytes: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "hbm_resident_bytes",
+        "Device-resident bytes registered with the HBM accounting "
+        "registry, by device and kind.", "tpu"))
 
 
 @dataclass

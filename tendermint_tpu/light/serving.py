@@ -352,10 +352,15 @@ class LightVerifyCollector:
                     # re-verifies on host instead of failing client
                     # requests on headers that are actually valid
                     spub, smsg, ssig = cbatch._ed_probe_triple()
-                    dv = np.asarray(tpu_verify.verify_batch(
-                        [triples[i][0].bytes() for i in ed] + [spub],
-                        [triples[i][1] for i in ed] + [smsg],
-                        [triples[i][2] for i in ed] + [ssig]), bool)
+                    from ..crypto.tpu import ledger as tpu_ledger
+
+                    with tpu_ledger.workload("light"):
+                        dv = np.asarray(tpu_verify.verify_batch(
+                            [triples[i][0].bytes() for i in ed]
+                            + [spub],
+                            [triples[i][1] for i in ed] + [smsg],
+                            [triples[i][2] for i in ed] + [ssig]),
+                            bool)
                     # the launch LANDED: only now does it count as a
                     # device verify — a raising launch falls through
                     # to the host path as ONE host launch, never

@@ -249,11 +249,15 @@ class AdmissionCollector:
                     # invalid) is trusted and dies at the device,
                     # never paying a per-signature host re-check
                     spub, smsg, ssig = cbatch._ed_probe_triple()
-                    out = np.asarray(tpu_verify.verify_batch(
-                        [e.pub_key for e in envs] + [spub],
-                        [tx_envelope.sign_bytes(e.payload)
-                         for e in envs] + [smsg],
-                        [e.signature for e in envs] + [ssig]), bool)
+                    from ..crypto.tpu import ledger as tpu_ledger
+
+                    with tpu_ledger.workload("admission"):
+                        out = np.asarray(tpu_verify.verify_batch(
+                            [e.pub_key for e in envs] + [spub],
+                            [tx_envelope.sign_bytes(e.payload)
+                             for e in envs] + [smsg],
+                            [e.signature for e in envs] + [ssig]),
+                            bool)
                     met.launches.inc(backend="device")
                     crypto_metrics().batch_lanes.inc(n, backend="tpu")
                     if out[-1]:

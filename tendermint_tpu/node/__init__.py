@@ -194,6 +194,16 @@ class Node(Service):
             _expanded.set_shard_crossover(
                 cfg.mesh.expanded_shard_crossover_keys or None)
             _resident.set_arena_shards(cfg.mesh.arena_shards)
+        # [crypto] watchdog/ledger knobs — same unconditional-when-
+        # loaded rule as [mesh] above (watchdog + ledger are jax-free;
+        # importing them here never triggers backend bring-up)
+        from ..crypto.tpu import ledger as _ledger
+        from ..crypto.tpu import watchdog as _watchdog
+
+        _watchdog.configure(cfg.crypto.backend,
+                            cfg.crypto.watchdog_window_s)
+        if cfg.crypto.ledger_capacity != _ledger.capacity():
+            _ledger.set_capacity(cfg.crypto.ledger_capacity)
         self.block_store = BlockStore(_db(cfg, "blockstore",
                                           self.in_memory))
         self.state_store = Store(_db(cfg, "state", self.in_memory))

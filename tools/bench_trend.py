@@ -20,8 +20,17 @@ are only comparable WITHIN a backend, so this tool:
      misrepresentation exits non-zero; the suite runs this so a future
      fallback round can never silently extend the silicon trajectory.
 
+MULTICHIP_r*.json mesh dry runs fold into the same table: rounds that
+stamp backend/device (tools/crypto_bench.py --mesh) get the identical
+silicon/cpu_fallback hard separation and misrepresentation check;
+legacy dryrun rounds (ok/rc/n_devices only) carry no backend evidence
+and sit as no-data rows — visible, never extending either trajectory —
+while a failed, non-skipped dryrun is a problem under --check.
+
 Usage:
-    python tools/bench_trend.py [--check] [--glob 'BENCH_r*.json'] [DIR]
+    python tools/bench_trend.py [--check] [--glob 'BENCH_r*.json']
+                                [--multichip-glob 'MULTICHIP_r*.json']
+                                [DIR]
 """
 
 from __future__ import annotations
@@ -32,15 +41,15 @@ import json
 import os
 import sys
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# the ONE classification vocabulary (shared with bench.py's stamp,
+# silicon_record.record_if_tpu and the silicon watchdog)
+from tendermint_tpu.crypto.tpu.backend import classify_stamps  # noqa: E402
+
 REGRESSION_PCT = 10.0
-
-_CPU_DEVICE_MARKERS = ("cpu", "host")
-_SILICON_BACKENDS = ("tpu", "silicon", "device")
-
-
-def _device_is_cpu(device: str) -> bool:
-    d = device.lower()
-    return any(m in d for m in _CPU_DEVICE_MARKERS)
 
 
 def _rate_unit(unit: str) -> bool:
@@ -62,35 +71,46 @@ def classify(entry: dict) -> dict:
     row["value"] = parsed.get("value")
     row["unit"] = parsed.get("unit")
     row["metric"] = parsed.get("metric")
-    fallback_stamp = bool(parsed.get("cpu_fallback"))
-    backend_stamp = str(parsed.get("backend", "")).lower()
-
-    if backend_stamp:
-        claims_silicon = any(b in backend_stamp
-                             for b in _SILICON_BACKENDS) and \
-            "cpu" not in backend_stamp
-        if claims_silicon and (fallback_stamp or _device_is_cpu(device)):
-            row["backend"] = "cpu_fallback"
-            row["problems"].append(
-                f"misrepresented: backend stamp {backend_stamp!r} but "
-                f"cpu_fallback={fallback_stamp} device={device!r}")
-        else:
-            row["backend"] = ("silicon" if claims_silicon
-                              else "cpu_fallback")
-    elif fallback_stamp or (device and _device_is_cpu(device)):
-        row["backend"] = "cpu_fallback"
-    elif device:
-        row["backend"] = "silicon"
-    else:
-        # a measured value with no device/backend evidence at all
-        # cannot claim the silicon trajectory
-        row["backend"] = "cpu_fallback"
-        row["problems"].append(
-            "unattributed: measured value with no device/backend stamp")
+    backend, problems = classify_stamps(
+        parsed.get("backend", ""), bool(parsed.get("cpu_fallback")),
+        device)
+    row["backend"] = backend
+    row["problems"].extend(problems)
     return row
 
 
-def load_rounds(paths: list[str]) -> list[dict]:
+def classify_multichip(entry: dict) -> dict:
+    """One MULTICHIP_r*.json -> a trajectory row. Newer rounds
+    (crypto_bench --mesh) stamp backend/device inline and get the same
+    hard separation; legacy dryruns (ok/rc/n_devices/tail only) have
+    no backend evidence and no measured value, so they sit as no-data
+    rows. A failed, non-skipped dryrun is a problem."""
+    parsed = entry.get("parsed")
+    src = parsed if isinstance(parsed, dict) else entry
+    row = {"round": entry.get("n"), "rc": entry.get("rc"),
+           "backend": "no-data", "value": src.get("value"),
+           "unit": src.get("unit"),
+           "metric": src.get("metric") or "multichip_dryrun",
+           "device": src.get("device"),
+           "n_devices": src.get("n_devices", entry.get("n_devices")),
+           "problems": []}
+    if entry.get("skipped"):
+        return row
+    if src.get("backend") or src.get("device"):
+        backend, problems = classify_stamps(
+            src.get("backend", ""), bool(src.get("cpu_fallback")),
+            str(src.get("device", "")))
+        row["backend"] = backend
+        row["problems"].extend(problems)
+    ok = entry.get("ok", entry.get("rc") == 0)
+    if not ok:
+        row["problems"].append(
+            f"multichip dryrun failed (rc={entry.get('rc')})")
+    return row
+
+
+def load_rounds(paths: list[str], kind: str = "bench") -> list[dict]:
+    classifier = classify_multichip if kind == "multichip" else classify
     rows = []
     for p in sorted(paths):
         try:
@@ -102,7 +122,7 @@ def load_rounds(paths: list[str]) -> list[dict]:
                          "unit": None, "device": None, "metric": None,
                          "problems": [f"unreadable: {e!r}"]})
             continue
-        row = classify(entry)
+        row = classifier(entry)
         row["file"] = os.path.basename(p)
         rows.append(row)
     return rows
@@ -146,10 +166,12 @@ def render_table(rows: list[dict]) -> str:
         for r in sel:
             val = (f"{r['value']} {r['unit']}" if r["value"] is not None
                    else f"(rc={r['rc']})")
+            nd = (f" n_devices={r['n_devices']}"
+                  if r.get("n_devices") else "")
             flag = "  !! " + "; ".join(r["problems"]) if r["problems"] \
                 else ""
             lines.append(f"  {r.get('file', r['round']):<18} {val:<18} "
-                         f"device={r['device']}{flag}")
+                         f"device={r['device']}{nd}{flag}")
     return "\n".join(lines)
 
 
@@ -159,6 +181,7 @@ def main(argv=None) -> int:
     ap.add_argument("dir", nargs="?", default=".",
                     help="directory holding the BENCH files")
     ap.add_argument("--glob", default="BENCH_r*.json")
+    ap.add_argument("--multichip-glob", default="MULTICHIP_r*.json")
     ap.add_argument("--check", action="store_true",
                     help="exit non-zero on any regression or "
                          "misrepresented round")
@@ -170,6 +193,8 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     rows = load_rounds(paths)
+    mc_paths = _glob.glob(os.path.join(args.dir, args.multichip_glob))
+    rows += load_rounds(mc_paths, kind="multichip")
     print(render_table(rows))
 
     problems = [p for r in rows for p in r["problems"]]

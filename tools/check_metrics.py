@@ -41,6 +41,17 @@ NAMESPACES = {
 
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 
+# Launch-ledger / silicon-watchdog families the observability contract
+# depends on (crypto/tpu/{ledger,watchdog}.py feed them; the /status
+# device check and docs/OBSERVABILITY.md state table read them): a
+# refactor must not silently drop any from the catalog.
+REQUIRED = {
+    "tpu_effective_backend",
+    "tpu_launch_ledger_records_total",
+    "tpu_launch_ledger_evictions_total",
+    "tpu_hbm_resident_bytes",
+}
+
 
 def collect_problems() -> list[str]:
     """All lint findings, empty means clean. Importing here (not at
@@ -87,7 +98,13 @@ def collect_problems() -> list[str]:
         if not (m.help or "").strip():
             problems.append(f"{name}: empty help text")
 
-    # 4. docs table sync.
+    # 4. required families (ledger/watchdog observability contract).
+    for name in sorted(REQUIRED - set(declared)):
+        problems.append(
+            f"{name}: required launch-ledger/watchdog metric missing "
+            "from the declared catalog")
+
+    # 5. docs table sync.
     problems.extend(check_docs_table(set(declared)))
     return problems
 

@@ -22,12 +22,16 @@ gate on the device string); CPU smoke runs must not pollute the file.
 import fcntl
 import json
 import os
+import sys
 from datetime import datetime, timezone
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 RECORD_PATH = os.environ.get(
     "TM_TPU_SILICON_RECORD",
     os.path.join(_REPO, "docs", "measured_silicon.json"))
+
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
 
 def _now() -> str:
@@ -38,8 +42,17 @@ def backend_label(device) -> str:
     """The one backend classification every measurement tool stamps
     (bench.py, crypto_bench, the multichip dryrun) and the gate
     record_if_tpu enforces — so a CPU-fallback number can never drift
-    into passing as silicon in one tool but not another."""
-    return "tpu" if "tpu" in str(device).lower() else "cpu-fallback"
+    into passing as silicon in one tool but not another. Delegates to
+    crypto/tpu/backend.py, the SAME helper the silicon watchdog and
+    bench_trend's misrepresentation check classify with."""
+    try:
+        from tendermint_tpu.crypto.tpu.backend import (
+            backend_label as _label,
+        )
+
+        return _label(device)
+    except ImportError:  # pragma: no cover - standalone-file fallback
+        return "tpu" if "tpu" in str(device).lower() else "cpu-fallback"
 
 
 def load() -> dict:
