@@ -448,23 +448,25 @@ class SpeculationPlane:
             met.launches.inc(backend="device")
             crypto_metrics().batch_lanes.inc(n, backend="tpu")
             if not out[0]:
-                # sentinel mismatch: wrong-verdict device — open the
+                # sentinel mismatch: wrong-verdict device — open a
                 # breaker and re-verify on host rather than storing
                 # garbage verdicts for later serving. A sharded arena
                 # attributes the failure to the specific chip(s) whose
-                # per-shard sentinel broke (the per-device breaker
-                # attribution the mesh fabric adds): the breaker stays
-                # backend-wide, the log names the chip.
+                # per-shard sentinel broke: ONLY those chips' per-
+                # device breakers open (the fabric reshards over the
+                # survivors); an unsharded arena can't attribute, so
+                # the backend-wide breaker opens as before.
                 failed = getattr(arena, "failed_shards", lambda: [])()
+                devices = [dev for _, dev in failed]
                 detail = ", ".join(
                     f"shard {i} ({dev})" for i, dev in failed) or None
-                cbatch.mark_device_failed("ed25519", device=detail)
+                cbatch.mark_device_failed("ed25519",
+                                          device=devices or None,
+                                          reason="sentinel")
                 logger.error(
                     "speculative launch (%d lanes) failed its "
-                    "known-answer sentinel%s; breaker open %.1fs, "
-                    "re-verifying on host", n,
-                    f" on {detail}" if detail else "",
-                    cbatch.breaker("ed25519").cooldown_remaining())
+                    "known-answer sentinel%s; re-verifying on host", n,
+                    f" on {detail}" if detail else "")
                 met.launches.inc(backend="host_recheck")
                 tpu_metrics().host_fallbacks.inc()
                 return self._host_verify(entry, kept, met)
@@ -488,6 +490,12 @@ class SpeculationPlane:
             # splices upload only each chip's ~1/N of the deltas, and
             # every shard carries its own known-answer sentinel
             self._arena = make_arena(self.arena_lanes)
+        elif getattr(self._arena, "ensure_mesh", None) is not None:
+            # per-device breaker evicted a chip (or re-admitted one):
+            # the arena rebuilds over the effective mesh — installed
+            # keys replay into the new layout, and this entry's lanes
+            # re-splice below as they do every launch
+            self._arena.ensure_mesh()
         if len(entry.valset.validators) + 1 > self._arena.capacity:
             return None
         if self._arena_keys_hash != entry.valset_hash:

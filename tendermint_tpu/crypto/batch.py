@@ -78,6 +78,7 @@ class CircuitBreaker:
 
     def __init__(self, backend: str, probe):
         self.backend = backend
+        self._label = backend  # log/metric identity; subclasses extend
         self._probe = probe  # () -> bool: synthetic batch round trip
         self._lock = threading.Lock()
         self.state = CLOSED
@@ -109,6 +110,11 @@ class CircuitBreaker:
         except Exception:  # pragma: no cover - metrics never fatal
             pass
 
+    def _count_open(self) -> None:
+        from ..libs.metrics import crypto_metrics
+
+        crypto_metrics().breaker_opens.inc(backend=self.backend)
+
     def _open_locked(self) -> None:
         from ..libs.net import jittered_backoff
 
@@ -117,12 +123,10 @@ class CircuitBreaker:
                               BREAKER_MAX_COOLDOWN_S)
         self._open_until = clock.monotonic() + cd
         self._set_state(OPEN)
-        from ..libs.metrics import crypto_metrics
-
-        crypto_metrics().breaker_opens.inc(backend=self.backend)
+        self._count_open()
         logger.warning(
             "device breaker OPEN (%s): failure #%d, cooldown %.1fs",
-            self.backend, self.consecutive_failures, cd)
+            self._label, self.consecutive_failures, cd)
 
     def record_failure(self) -> None:
         """A production (or probe) launch raised on this backend."""
@@ -146,7 +150,7 @@ class CircuitBreaker:
         try:
             ok = bool(self._probe())
         except Exception:
-            logger.exception("half-open probe raised (%s)", self.backend)
+            logger.exception("half-open probe raised (%s)", self._label)
             ok = False
         from ..libs.metrics import crypto_metrics
 
@@ -159,7 +163,7 @@ class CircuitBreaker:
                 self._set_state(CLOSED)
                 logger.warning(
                     "device breaker CLOSED (%s): probe succeeded",
-                    self.backend)
+                    self._label)
             else:
                 self.consecutive_failures += 1
                 self._open_locked()
@@ -224,6 +228,151 @@ _BREAKERS: dict[str, CircuitBreaker] = {
     "sr25519": CircuitBreaker("sr25519", _probe_sr25519),
 }
 
+_BACKEND_PROBES = {"ed25519": _probe_ed25519, "sr25519": _probe_sr25519}
+
+
+class DeviceBreaker(CircuitBreaker):
+    """Per-mesh-device breaker UNDER the per-backend one: a chip that
+    raises or returns wrong verdicts is evicted alone (its breaker
+    opens, the fabric reshards over the survivors) while the backend
+    breaker stays closed and every other chip keeps serving. The
+    half-open probe is the same PROBE_LANES known-answer batch, pinned
+    to THIS device via jax.default_device — a passing probe re-admits
+    the chip and the next dispatch reshards back to full width.
+    Backend-wide semantics are preserved by mark_device_failed(): when
+    every mesh device is open, the backend breaker opens too."""
+
+    def __init__(self, backend: str, device: str):
+        super().__init__(backend, None)
+        self.device = device
+        self._label = f"{backend} {device}"
+        self._probe = self._device_probe
+
+    def _set_state(self, state: str) -> None:
+        self.state = state
+        try:
+            from ..libs.metrics import tpu_metrics
+
+            tpu_metrics().device_breaker_state.set(
+                _STATE_CODE[state], device=self.device)
+        except Exception:  # pragma: no cover - metrics never fatal
+            pass
+
+    def _count_open(self) -> None:
+        # device evictions are counted by mark_device_failed()
+        # (tpu_mesh_evictions_total{device,reason}); the per-backend
+        # crypto_breaker_opens_total stays backend-wide-only.
+        pass
+
+    def _device_probe(self) -> bool:
+        import jax
+
+        dev = next((d for d in jax.devices()
+                    if str(d) == self.device), None)
+        if dev is None:
+            return False
+        probe = _BACKEND_PROBES[self.backend]
+        # The probe's 8 lanes pad below the shard crossover, so it
+        # launches single-device — pinning the default device makes it
+        # a round trip through THIS chip only. A recursive
+        # evicted_devices(probe=True) during the probe sees
+        # self._probing and keeps the device listed as evicted.
+        with jax.default_device(dev):
+            return bool(probe())
+
+
+# (backend, full device string) -> DeviceBreaker; created lazily on
+# first eviction so a mesh-less process never mints device state.
+_DEVICE_BREAKERS: dict[tuple[str, str], DeviceBreaker] = {}
+_DEVICE_LOCK = threading.Lock()
+
+
+def device_breaker(backend: str, device: str) -> DeviceBreaker:
+    with _DEVICE_LOCK:
+        br = _DEVICE_BREAKERS.get((backend, device))
+        if br is None:
+            br = _DEVICE_BREAKERS[(backend, device)] = DeviceBreaker(
+                backend, device)
+        return br
+
+
+def device_breaker_states(backend: str | None = None) -> dict[str, str]:
+    """{device: state} for the /status device check (all backends
+    merged unless one is named)."""
+    with _DEVICE_LOCK:
+        return {dev: br.state
+                for (be, dev), br in sorted(_DEVICE_BREAKERS.items())
+                if backend is None or be == backend}
+
+
+def evicted_devices(backend: str = "ed25519",
+                    probe: bool = False) -> list[str]:
+    """Sorted full device strings whose per-device breaker is not
+    closed. probe=False is a pure read (watchdog, /status — must never
+    launch); probe=True additionally runs any DUE half-open per-device
+    probes inline, so dispatch entry points both learn the surviving
+    set and drive re-admission."""
+    with _DEVICE_LOCK:
+        brs = [br for (be, _), br in _DEVICE_BREAKERS.items()
+               if be == backend]
+    out = []
+    readmitted = False
+    for br in brs:
+        if probe and not br.available():
+            br.acquire()  # no-op while cooling down / already probing
+            if br.available():
+                readmitted = True
+        if not br.available():
+            out.append(br.device)
+    if readmitted:
+        _set_active_devices(backend)
+    return sorted(out)
+
+
+def readmit_device(backend: str, device: str) -> None:
+    """Force a device's breaker closed without a probe — the operator
+    override (and the deterministic sim/scenario hook; the natural
+    path is a passing half-open probe via evicted_devices(probe=True))."""
+    with _DEVICE_LOCK:
+        br = _DEVICE_BREAKERS.get((backend, device))
+    if br is not None:
+        br.reset()
+        logger.warning("mesh device %s force re-admitted (%s backend)",
+                       device, backend)
+    _set_active_devices(backend)
+
+
+def _mesh_device_strs() -> list[str]:
+    """Full device strings of the (undegraded) verify mesh; [] when no
+    multi-device mesh exists or jax never came up."""
+    import sys
+
+    if "jax" not in sys.modules:  # pure read: never trigger bring-up
+        return []
+    try:
+        from .tpu import verify as tpu_verify
+
+        mesh = tpu_verify._mesh()
+    except Exception:  # pragma: no cover - backend bring-up failed
+        return []
+    if mesh is None:
+        return []
+    return [str(d) for d in mesh.devices.flat]
+
+
+def _set_active_devices(backend: str = "ed25519") -> None:
+    devs = _mesh_device_strs()
+    if not devs:
+        return
+    try:
+        from ..libs.metrics import tpu_metrics
+
+        evicted = set(evicted_devices(backend))
+        tpu_metrics().mesh_active_devices.set(
+            len([d for d in devs if d not in evicted]))
+    except Exception:  # pragma: no cover - metrics never fatal
+        pass
+
 
 def breaker(backend: str = "ed25519") -> CircuitBreaker:
     return _BREAKERS[backend]
@@ -235,9 +384,26 @@ def breaker_states() -> dict[str, str]:
 
 
 def reset_breakers() -> None:
-    """Test hook: force every backend breaker closed."""
+    """Test hook: force every backend AND device breaker closed."""
     for b in _BREAKERS.values():
         b.reset()
+    with _DEVICE_LOCK:
+        device_brs = list(_DEVICE_BREAKERS.values())
+        _DEVICE_BREAKERS.clear()
+    for b in device_brs:
+        b.reset()
+
+
+# The silicon watchdog (crypto/tpu/watchdog.py — jax-free) reports
+# mesh_degraded off this pure read (no probes, no bring-up);
+# registering here keeps the dependency one-directional.
+try:
+    from .tpu import watchdog as _watchdog
+
+    _watchdog.register_evicted_supplier(
+        lambda: evicted_devices("ed25519", probe=False))
+except Exception:  # pragma: no cover - watchdog import never fatal
+    pass
 
 
 # Host-only override (tendermint_tpu/sim): a deterministic simulation
@@ -270,20 +436,46 @@ def device_available(backend: str | None = None) -> bool:
 
 
 def mark_device_failed(backend: str = "ed25519",
-                       device: str | None = None) -> None:
-    """Open the backend's breaker. `device` attributes the failure to
-    a specific mesh chip (per-shard sentinel mismatches from
-    MeshResidentArena launches) — the breaker itself stays
-    per-backend (one wrong-verdict chip poisons any launch that
-    shards lanes onto it, so the whole mesh must cool down), but the
-    operator sees WHICH chip to pull from the log."""
-    _BREAKERS[backend].record_failure()
-    if device:
-        logger.error("device failure attributed to mesh device(s) %s "
-                     "(%s backend)", device, backend)
+                       device=None, reason: str = "launch_error") -> None:
+    """Record a device-side verify failure.
+
+    With no `device`, the failure is backend-wide (a raising launch
+    with no shard attribution): the backend breaker opens and every
+    verify takes the host path until a probe passes — the PR-3
+    semantics, unchanged.
+
+    With `device` (a full device string, or a sequence of them — e.g.
+    from MeshResidentArena.failed_shards()), only the NAMED chips'
+    per-device breakers open: the fabric reshards over the survivors
+    and keeps serving on silicon. Backend-wide semantics are preserved
+    as the limit case — when every mesh device is open, the backend
+    breaker opens too."""
     from ..libs.metrics import crypto_metrics
 
     crypto_metrics().device_failures.inc()
+    if not device:
+        _BREAKERS[backend].record_failure()
+        return
+    names = [device] if isinstance(device, str) else list(device)
+    for name in names:
+        device_breaker(backend, name).record_failure()
+        try:
+            from ..libs.metrics import tpu_metrics
+
+            tpu_metrics().mesh_evictions.inc(device=name, reason=reason)
+        except Exception:  # pragma: no cover - metrics never fatal
+            pass
+        logger.error("mesh device %s evicted (%s backend, reason=%s); "
+                     "resharding fabric over survivors", name, backend,
+                     reason)
+    mesh_devs = _mesh_device_strs()
+    if mesh_devs and set(evicted_devices(backend)) >= set(mesh_devs):
+        # every chip is out — that IS a backend-wide failure
+        logger.error("all %d mesh devices evicted (%s backend); "
+                     "opening the backend breaker", len(mesh_devs),
+                     backend)
+        _BREAKERS[backend].record_failure()
+    _set_active_devices(backend)
 
 
 class BatchVerifier:

@@ -31,14 +31,20 @@ SILICON_BACKENDS = ("tpu", "silicon", "device")
 
 # The watchdog's effective-backend classification (closed set; the
 # tpu_effective_backend gauge is one-hot over exactly these):
-#   tpu          — a successful launch landed on accelerator silicon
-#                  within the window
-#   cpu_fallback — launches are completing on CPU (or raising and
-#                  degrading to host) with no silicon success in the
-#                  window
-#   idle         — records exist, but none within the window
-#   unknown      — no device launch has ever been recorded
-EFFECTIVE_STATES = ("tpu", "cpu_fallback", "idle", "unknown")
+#   tpu           — a successful launch landed on accelerator silicon
+#                   within the window
+#   mesh_degraded — launches are completing, but one or more mesh
+#                   devices are breaker-evicted: the fabric serves on
+#                   the SURVIVORS (verify continuity, not a backend
+#                   fallback — the distinction the mesh degradation
+#                   runbook triages on)
+#   cpu_fallback  — launches are completing on CPU (or raising and
+#                   degrading to host) with no silicon success in the
+#                   window
+#   idle          — records exist, but none within the window
+#   unknown       — no device launch has ever been recorded
+EFFECTIVE_STATES = ("tpu", "mesh_degraded", "cpu_fallback", "idle",
+                    "unknown")
 
 
 def device_is_cpu(device: str) -> bool:

@@ -458,7 +458,11 @@ class ExpandedKeys:
         self.sharded = False
         self.n_shards = 1
         self.keys_per_shard = v
-        self.mesh = tv._mesh()
+        self._reshard_lock = threading.Lock()
+        # Build over the EFFECTIVE mesh (full mesh minus evicted
+        # devices): a build while degraded shards over the survivors,
+        # and _maybe_reshard() rebuilds live when the set changes.
+        self.mesh = tv.effective_mesh()
         # Shard above the crossover — or above the single-chip budget
         # regardless of the crossover: an operator raising the
         # crossover past the budget must degrade to sharding, not to a
@@ -516,6 +520,85 @@ class ExpandedKeys:
                     "comb_tables", _ledger.default_device_str(), nbytes)
         except Exception:  # pragma: no cover - accounting never fatal
             pass
+
+    def _release_hbm(self) -> None:
+        """Drop this build's bytes from the HBM accounting registry
+        (register_hbm with 0 bytes unregisters): a live reshard must
+        not leave the old placement's bytes attributed to devices —
+        possibly evicted ones — that no longer hold a shard."""
+        try:
+            kind = "table_shard" if self.sharded else "comb_tables"
+            if self.mesh is not None:
+                for d in list(self.mesh.devices.flat):
+                    _ledger.register_hbm(kind, str(d), 0)
+            else:
+                _ledger.register_hbm(
+                    kind, _ledger.default_device_str(), 0)
+        except Exception:  # pragma: no cover - accounting never fatal
+            pass
+
+    def _maybe_reshard(self) -> None:
+        """Live fabric reshard: when the effective mesh (full mesh
+        minus breaker-evicted devices) no longer matches the mesh this
+        build is placed on — a device was just evicted, or a half-open
+        probe re-admitted one — rebuild the placement over the
+        SURVIVING device set in place. Key-range-sharded tables
+        rebuild D -> D' shards from the pubkey bytes (recomputable;
+        the raw keys are kept); replicated tables re-place onto the
+        new mesh. Old shard HBM is released from the accounting
+        registry first and the new placement re-registers. Verdicts
+        are unchanged: same keys, same kernels — only device placement
+        and per-device key ranges move. Breaker events are rare, so
+        the lock never contends on the steady-state path (the
+        identity fast-path above it is lock-free)."""
+        if self.mesh is None:
+            return
+        want = tv.effective_mesh()
+        if want is self.mesh:
+            return
+        with self._reshard_lock:
+            want = tv.effective_mesh()
+            if want is self.mesh:
+                return
+            if want is None:
+                # Fewer than 2 survivors: no mesh can form. Keep the
+                # current placement — backend-wide escalation (all
+                # devices evicted) is handled by mark_device_failed.
+                return
+            have = [str(d) for d in self.mesh.devices.flat]
+            if [str(d) for d in want.devices.flat] == have:
+                self.mesh = want  # same devices, fresher mesh object
+                return
+            import time as _time
+
+            t0 = _time.perf_counter()
+            self._release_hbm()
+            self.mesh = want
+            if self.sharded:
+                a_raw = np.frombuffer(
+                    b"".join(self.pubkeys), np.uint8).reshape(-1, 32)
+                self._build_sharded(a_raw)
+            else:
+                import jax
+
+                _, _, repl_s = tv._shardings(want)
+                self.tables = jax.device_put(self.tables, repl_s)
+                self.key_ok = jax.device_put(self.key_ok, repl_s)
+                self.akeys = jax.device_put(self.akeys, repl_s)
+                self._register_hbm()
+            dt = _time.perf_counter() - t0
+            try:
+                from ...libs.metrics import tpu_metrics
+
+                tpu_metrics().reshard_seconds.observe(dt)
+            except Exception:  # pragma: no cover - metrics never fatal
+                pass
+            from .. import batch as cbatch
+
+            cbatch.logger.warning(
+                "live fabric reshard: %d-key tables rebuilt over %d "
+                "device(s) in %.3fs", len(self.pubkeys),
+                int(want.devices.size), dt)
 
     def _build_tables(self, a_raw: np.ndarray, device=None):
         """Chunked comb-table build: (V, 32) pubkey rows ->
@@ -687,7 +770,9 @@ class ExpandedKeys:
         lanes carry zero signatures (s_ok False) and are discarded by
         the caller's [:n] slice — instead of forfeiting the mesh."""
         btab = tv.b_comb_tables()
-        mesh = tv._mesh()
+        # the mesh the tables are PLACED on (effective mesh at build /
+        # last reshard) — lanes must shard over the same device set
+        mesh = self.mesh
         bucket = idx.shape[0]
         if mesh is not None and bucket >= tv._SHARD_MIN:
             import jax
@@ -808,6 +893,7 @@ class ExpandedKeys:
         n = len(indices)
         if n == 0:
             return np.zeros(0, bool)
+        self._maybe_reshard()
 
         def prepare():
             idx, packed, well_formed = self._prepare(indices, msgs, sigs)
@@ -853,6 +939,8 @@ class ExpandedKeys:
             rec.bytes_d2h = int(full.nbytes)
             if self.sharded:
                 rec.n_devices = self.n_shards
+                rec.active_devices = [
+                    str(d) for d in self.mesh.devices.flat]
             res = full[:n] & well_formed
             rec.verdicts(res)
             return res
@@ -967,6 +1055,7 @@ class ExpandedKeys:
         n = len(indices)
         if n == 0:
             return np.zeros(0, bool)
+        self._maybe_reshard()
 
         def prepare():
             idx, fields, well_formed, width = self._prepare_structured(

@@ -181,8 +181,8 @@ class LaunchRecord:
     __slots__ = ("kernel", "workload", "wall", "mono", "_t0",
                  "lanes", "capacity", "bytes_h2d", "bytes_d2h",
                  "compile_hit", "device", "n_devices", "shard_lanes",
-                 "verdict", "ok_lanes", "stages_ms", "error", "_done",
-                 "_restamp")
+                 "active_devices", "verdict", "ok_lanes", "stages_ms",
+                 "error", "_done", "_restamp")
 
     def __init__(self, kernel: str):
         self.kernel = kernel
@@ -198,6 +198,10 @@ class LaunchRecord:
         self.device = ""
         self.n_devices = 1
         self.shard_lanes: list[int] | None = None
+        # Device set the launch actually spanned (mesh launches stamp
+        # the EFFECTIVE mesh) — lets consumers (bench_trend, the mesh
+        # degradation runbook) tell a degraded round from a full one.
+        self.active_devices: list[str] | None = None
         self.verdict = ""
         self.ok_lanes = 0
         self.stages_ms: dict[str, float] = {}
@@ -283,6 +287,9 @@ class LaunchRecord:
             "stages_ms": dict(self.stages_ms),
             "shard_lanes": (list(self.shard_lanes)
                             if self.shard_lanes is not None else None),
+            "active_devices": (list(self.active_devices)
+                               if self.active_devices is not None
+                               else None),
             "verdict": self.verdict or "ok",
             "ok_lanes": self.ok_lanes,
             "error": self.error,

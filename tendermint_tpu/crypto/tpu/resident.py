@@ -419,9 +419,14 @@ class MeshResidentArena:
 
         from .. import batch as cbatch
 
-        mesh = mesh if mesh is not None else tv._mesh()
+        mesh = mesh if mesh is not None else tv.effective_mesh()
         assert mesh is not None, "MeshResidentArena needs a device mesh"
         self.mesh = mesh
+        self._req_lanes = lanes
+        # host mirror of installed app keys (global slot -> 32 bytes):
+        # ensure_mesh() replays them into the new round-robin layout
+        # when the shard set changes
+        self._keys_host: dict[int, bytes] = {}
         self.devices = list(mesh.devices.flat)
         d_n = len(self.devices)
         self.n_shards = d_n
@@ -528,6 +533,8 @@ class MeshResidentArena:
         assert start >= 1, "slot 0 is the sentinel"
         assert start + len(pubkeys) <= self.capacity
         assert all(len(p) == 32 for p in pubkeys)
+        for off, p in enumerate(pubkeys):
+            self._keys_host[start + off] = bytes(p)
         ab = np.asarray(self._ab).copy()
         i = np.arange(start - 1, start - 1 + len(pubkeys))
         ab[i % self.n_shards, i // self.n_shards + 1] = np.frombuffer(
@@ -551,6 +558,71 @@ class MeshResidentArena:
         inactive; buffers stay resident for the next splices."""
         self._active = _mesh_clear_fn()(self._active)
         self._active_lanes = self.n_shards
+
+    def ensure_mesh(self) -> bool:
+        """Re-splice the arena over the current effective mesh. When a
+        per-device breaker evicts a chip (or a half-open probe
+        re-admits one), the shard set changes: the arena rebuilds its
+        (D', per', ...) buffers over the SURVIVORS as the same single
+        donated jit program (one executable, the PR-13 constraint),
+        replays the installed app keys into the new round-robin
+        layout, and keeps the staged templates. Old per-device
+        arena_shard HBM is released from the accounting registry.
+        Splice state (signatures/patches) does NOT carry over — lanes
+        come back deactivated and the speculation plane's next height
+        splice repopulates them, exactly the deactivate_all contract.
+        Returns True when a rebuild happened."""
+        want = tv.effective_mesh()
+        if want is None or want is self.mesh:
+            return False
+        have = [str(d) for d in self.mesh.devices.flat]
+        if [str(d) for d in want.devices.flat] == have:
+            self.mesh = want  # same devices, fresher mesh object
+            return False
+        import time as _time
+
+        from .. import batch as cbatch
+
+        t0 = _time.perf_counter()
+        try:
+            for dev in self.devices:
+                _ledger.register_hbm("arena_shard", str(dev), 0)
+        except Exception:  # pragma: no cover - accounting never fatal
+            pass
+        pre, pre_len = self.pre, self.pre_len
+        suf, suf_len = self.suf, self.suf_len
+        keys = dict(self._keys_host)
+        reup = self.reupload_bytes
+        self.__init__(self._req_lanes, self.width, mesh=want)
+        self.pre, self.pre_len = pre, pre_len
+        self.suf, self.suf_len = suf, suf_len
+        self.reupload_bytes = reup
+        # replay installed keys in contiguous runs (install_keys
+        # re-fills _keys_host); slots past the new capacity — possible
+        # only when bucketing inflated the OLD capacity — are dropped,
+        # the same as a fresh arena sized for _req_lanes
+        slots = sorted(s for s in keys if s + 1 <= self.capacity)
+        run_start, run = None, []
+        for s in slots + [None]:
+            if run and (s is None or s != run_start + len(run)):
+                self.install_keys(run, start=run_start)
+                run = []
+            if s is None:
+                break
+            if not run:
+                run_start = s
+            run.append(keys[s])
+        dt = _time.perf_counter() - t0
+        try:
+            from ...libs.metrics import tpu_metrics
+
+            tpu_metrics().reshard_seconds.observe(dt)
+        except Exception:  # pragma: no cover - metrics never fatal
+            pass
+        cbatch.logger.warning(
+            "live arena reshard: %d-lane arena rebuilt over %d "
+            "shard(s) in %.3fs", self._req_lanes, self.n_shards, dt)
+        return True
 
     # -- the steady-state hot path ------------------------------------
 
@@ -713,7 +785,7 @@ def make_arena(lanes: int, width: int = WIDTH):
     """The speculation plane's arena factory: per-device shards when a
     mesh exists (and [mesh] arena_shards is on), the classic
     single-device arena otherwise."""
-    mesh = tv._mesh()
+    mesh = tv.effective_mesh()
     if _ARENA_SHARDS and mesh is not None:
         return MeshResidentArena(lanes, width, mesh=mesh)
     return ResidentArena(lanes, width)

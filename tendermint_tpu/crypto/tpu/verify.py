@@ -283,6 +283,69 @@ def _mesh():
     return Mesh(np_.array(devs), ("dp",))
 
 
+def _shard_failpoints(mesh) -> None:
+    """`device.shard_fail` injection point, evaluated once per mesh
+    device per dispatch in deterministic device order (so `nth=K`
+    selects the K-th device of the first dispatch). The payload is the
+    device string: `error` models a raising chip, `corrupt` models a
+    NaN-verdict chip (the payload comes back mangled) — both evict
+    ONLY that device; the fabric must reshard and keep serving."""
+    from ...libs import failpoints
+
+    if not failpoints.any_armed():
+        return
+    from .. import batch as cbatch
+
+    for d in mesh.devices.flat:
+        name = str(d)
+        payload = name.encode()
+        try:
+            back = failpoints.hit("device.shard_fail", payload)
+        except failpoints.FailpointError:
+            cbatch.mark_device_failed("ed25519", device=name,
+                                      reason="failpoint")
+            continue
+        if back is not None and bytes(back) != payload:
+            cbatch.mark_device_failed("ed25519", device=name,
+                                      reason="failpoint")
+
+
+# degraded meshes keyed by the evicted-device tuple; tiny (bounded by
+# the distinct eviction sets a process actually sees)
+_DEGRADED_MESHES: dict[tuple, object] = {}
+
+
+def effective_mesh(probe: bool = True):
+    """The mesh the NEXT launch should ride: the full ('dp',) mesh
+    minus the devices currently evicted by per-device breakers
+    (crypto/batch.py). probe=True (dispatch entry) also runs any due
+    half-open per-device probes, so a passing probe re-admits its chip
+    and this very call returns the restored full-width mesh. Returns
+    None when no multi-device mesh survives (<=1 device: the
+    single-device path needs no mesh)."""
+    base = _mesh()
+    if base is None:
+        return None
+    _shard_failpoints(base)
+    from .. import batch as cbatch
+
+    evicted = tuple(cbatch.evicted_devices("ed25519", probe=probe))
+    if not evicted:
+        return base
+    gone = set(evicted)
+    devs = [d for d in base.devices.flat if str(d) not in gone]
+    if len(devs) < 2:
+        return None
+    m = _DEGRADED_MESHES.get(evicted)
+    if m is None:
+        import numpy as np_
+
+        from jax.sharding import Mesh
+
+        m = _DEGRADED_MESHES[evicted] = Mesh(np_.array(devs), ("dp",))
+    return m
+
+
 def mesh_lane_pad(bucket: int, mesh) -> int:
     """Round a lane bucket up to the next device multiple so an odd
     bucket rides the mesh on padded lanes instead of forfeiting it
@@ -450,7 +513,7 @@ def _launch_chunk(pubs, msgs, sigs, bucket: int, rec=None):
 
     n = len(pubs)
     t = tracing.TRACER
-    mesh = _mesh()
+    mesh = effective_mesh()
     shard = mesh is not None and bucket >= _SHARD_MIN
     if shard:
         # Odd buckets pad up to a device multiple (the extra lanes are
@@ -480,6 +543,7 @@ def _launch_chunk(pubs, msgs, sigs, bucket: int, rec=None):
             d = int(mesh.devices.size)
             rec.n_devices = d
             rec.shard_lanes = [bucket // d] * d
+            rec.active_devices = [str(dv) for dv in mesh.devices.flat]
     with stage("dispatch"), t.span(tracing.CRYPTO_DISPATCH, lanes=bucket):
         btab = b_comb_tables()
         if shard:

@@ -59,6 +59,28 @@ _LOCK = threading.Lock()
 _CONFIGURED = "auto"
 _WINDOW_S = DEFAULT_WINDOW_S
 
+# Zero-arg callable returning the currently breaker-evicted mesh
+# device strings (pure read — no probes, no jax). crypto/batch.py
+# registers it at import; the watchdog stays importable (and /status
+# servable) in processes that never load the breaker stack.
+_EVICTED_SUPPLIER = None
+
+
+def register_evicted_supplier(fn) -> None:
+    global _EVICTED_SUPPLIER
+    _EVICTED_SUPPLIER = fn
+
+
+def evicted_mesh_devices() -> list[str]:
+    """Mesh devices currently evicted by per-device breakers ([] when
+    no supplier is registered or the read fails)."""
+    if _EVICTED_SUPPLIER is None:
+        return []
+    try:
+        return sorted(_EVICTED_SUPPLIER())
+    except Exception:  # pragma: no cover - status read never fatal
+        return []
+
 
 def configure(backend: str = "auto",
               window_s: float = DEFAULT_WINDOW_S) -> None:
@@ -133,6 +155,13 @@ def classify(records: list[dict] | None = None) -> dict:
         state = "tpu"
     else:
         state = "cpu_fallback"
+    evicted = evicted_mesh_devices()
+    if evicted and succ and state in ("tpu", "cpu_fallback"):
+        # launches are completing while per-device breakers hold chips
+        # out of the mesh: degraded-mode verify CONTINUITY on the
+        # survivors, not a backend flip — named so the runbook (and
+        # the one-hot gauge) can tell the two apart
+        state = "mesh_degraded"
 
     last_ok = max((r["mono"] for r in succ), default=None)
     last_any = max((r["mono"] for r in all_recs), default=None)
@@ -141,6 +170,7 @@ def classify(records: list[dict] | None = None) -> dict:
     out = {
         "effective_backend": state,
         "configured_backend": _CONFIGURED,
+        "evicted_devices": evicted,
         "window_s": win,
         "launches_in_window": len(recent),
         "last_device_launch_age_s": (
@@ -193,7 +223,15 @@ def verdict() -> dict:
     if _CONFIGURED != "tpu":
         return out
     state = cls["effective_backend"]
-    if state == "cpu_fallback":
+    if state == "mesh_degraded":
+        ev = cls["evicted_devices"]
+        out["status"] = "degraded"
+        out["reason"] = (
+            "{} mesh device(s) evicted by per-device breakers ({}); "
+            "verify continues on the surviving devices until a "
+            "half-open probe re-admits them".format(
+                len(ev), ", ".join(ev)))
+    elif state == "cpu_fallback":
         out["status"] = "degraded"
         out["reason"] = (
             "crypto.backend=tpu but launches are landing on CPU or "

@@ -231,6 +231,19 @@ class HealthMonitor:
         if broken:
             reasons.append("breaker open ({}): verifying on host"
                            .format(", ".join(broken)))
+        # per-mesh-device breakers (a chip evicted from the fabric is
+        # mesh_degraded, NOT a backend fallback: the survivors serve)
+        dev_states = cbatch.device_breaker_states()
+        if dev_states:
+            dv["device_breakers"] = dev_states
+            evicted = sorted(d for d, s in dev_states.items()
+                             if s != "closed")
+            if evicted:
+                dv["evicted_devices"] = evicted
+                reasons.append(
+                    "mesh_degraded: device breaker open ({}); verify "
+                    "continues on the surviving devices".format(
+                        ", ".join(evicted)))
         try:
             from ..crypto.tpu import watchdog as _watchdog
 

@@ -131,6 +131,16 @@ CATALOG: tuple[FailpointDef, ...] = (
         "a device batch-verification kernel launch (ed25519 general "
         "kernel, sr25519 kernel; the CPU-jit degraded path is exempt)"),
     FailpointDef(
+        "device.shard_fail",
+        "one device of the verify mesh, evaluated per device in "
+        "deterministic order at every sharded dispatch "
+        "(crypto/tpu/verify.py effective_mesh — payload is the device "
+        "string, so `nth=K` selects the K-th device; `error` models a "
+        "raising chip, `corrupt` a NaN-verdict chip — either must "
+        "evict ONLY that device while the fabric reshards over the "
+        "survivors)",
+        payload=True),
+    FailpointDef(
         "abci.deliver",
         "an ABCI request leaving a proxy connection (all client "
         "types: local, socket, gRPC)"),

@@ -709,6 +709,25 @@ class TPUMetrics:
         "hbm_resident_bytes",
         "Device-resident bytes registered with the HBM accounting "
         "registry, by device and kind.", "tpu"))
+    device_breaker_state: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "device_breaker_state",
+        "Per-mesh-device circuit-breaker state (0 closed, 1 open, "
+        "2 half-open), by device.", "tpu"))
+    mesh_evictions: Counter = field(default_factory=lambda: DEFAULT.counter(
+        "mesh_evictions_total",
+        "Mesh devices evicted from the verify fabric (per-device "
+        "breaker opened), by device and reason.", "tpu"))
+    reshard_seconds: Histogram = field(
+        default_factory=lambda: DEFAULT.histogram(
+            "reshard_seconds",
+            "Wall time of a live fabric reshard (rebuilding key-range "
+            "shards / resident arena over the surviving device set).",
+            "tpu",
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30)))
+    mesh_active_devices: Gauge = field(default_factory=lambda: DEFAULT.gauge(
+        "mesh_active_devices",
+        "Devices currently serving the verify mesh (mesh size minus "
+        "evicted devices; 0 until a mesh forms).", "tpu"))
 
 
 @dataclass

@@ -646,6 +646,63 @@ def _withhold_parts() -> Scenario:
         tx_rate=2.0, min_height=3)
 
 
+def _mesh_loss_probe():
+    """Driver for mesh_device_loss: evict one verify-mesh device
+    mid-height (per-device breaker, reason="scenario"), sample the
+    watchdog's degraded view, then deterministically re-admit it
+    (readmit_device — the virtual clock cannot wait out the wall-clock
+    half-open cooldown) and check the fabric reports full width again.
+    Each lifecycle step that fails appends a first-class violation."""
+
+    async def probe(nodes, report):
+        from ..crypto.tpu import watchdog as _watchdog
+
+        tag = "[scenario=mesh_device_loss]"
+        # a real forced host mesh when the process has one (tests /
+        # sweep under the 8-device conftest env); a synthetic device
+        # name otherwise — per-device breakers key on strings, so the
+        # evict -> report -> re-admit lifecycle is identical
+        devs = _batch._mesh_device_strs()
+        dev = devs[3] if len(devs) > 3 else "sim-mesh:3"
+        report["mesh_device"] = dev
+        await asyncio.sleep(3.0)
+        _batch.mark_device_failed("ed25519", device=dev,
+                                  reason="scenario")
+        evicted = _watchdog.evicted_mesh_devices()
+        report["mesh_evicted"] = list(evicted)
+        if dev not in evicted:
+            report["violations"].append(
+                f"mesh_device_loss: {dev} not reported evicted after "
+                f"mark_device_failed (got {evicted}) {tag}")
+        if _batch.breaker("ed25519").state != _batch.CLOSED:
+            report["violations"].append(
+                "mesh_device_loss: backend breaker opened on a "
+                f"single-device eviction {tag}")
+        await asyncio.sleep(4.0)
+        _batch.readmit_device("ed25519", dev)
+        left = _watchdog.evicted_mesh_devices()
+        report["mesh_readmitted"] = list(left)
+        if dev in left:
+            report["violations"].append(
+                f"mesh_device_loss: {dev} still evicted after "
+                f"re-admission (got {left}) {tag}")
+
+    return probe
+
+
+def _mesh_device_loss() -> Scenario:
+    """A verify-mesh chip fails MID-HEIGHT: its per-device breaker
+    opens (the backend breaker stays closed), the watchdog reports the
+    eviction, the net keeps committing on the survivors, and the
+    device re-admits — liveness, app_hash_oracle and bounded_queues
+    stay green through the whole evict -> degraded -> re-admit
+    lifecycle."""
+    sc = Scenario(name="mesh_device_loss", nodes=4, topology="full",
+                  duration=14.0, tx_rate=2.0, min_height=4)
+    sc.probe = _mesh_loss_probe()
+    return sc
+
+
 def _double_propose() -> Scenario:
     return Scenario(
         name="double_propose", nodes=4, topology="full", duration=20.0,
@@ -658,7 +715,7 @@ SCENARIOS: dict = {}
 for _f in (_smoke_quorum, _smoke_partition, _smoke_churn,
            _smoke_equivocation, _smoke_garbage_flood, _trust_collapse,
            _timestamp_skew, _withhold_parts, _double_propose,
-           _wan_50, _valset_10k):
+           _mesh_device_loss, _wan_50, _valset_10k):
     _sc = _f()
     _sc.validate()
     SCENARIOS[_sc.name] = _f

@@ -17,12 +17,18 @@ exercises the mesh paths):
     chip individually (breaker opens, host re-verifies, the failing
     device is named);
   * the three fabric metrics (tpu_mesh_devices, tpu_shard_lanes_total,
-    tpu_table_shard_bytes) registered and moving.
+    tpu_table_shard_bytes) registered and moving;
+  * mesh self-healing — per-device breakers evicting a single chip
+    (live reshard to 7 shards, verdict parity full -> degraded ->
+    re-admitted), dispatch continuity across an eviction between
+    launches, the `device.shard_fail` failpoint, and the arena's
+    ensure_mesh() re-splice.
 
 The 10,240-lane commit acceptance (sharded tables + mesh arena +
-speculation serve at full size) and the real sr25519 mesh parity run
-in the slow tier — they are real-kernel compiles the tier-1 envelope
-cannot afford cold.
+speculation serve at full size), its degraded twin (device.shard_fail
+armed on one chip, 7-survivor verdicts + half-open re-admission) and
+the real sr25519 mesh parity run in the slow tier — they are
+real-kernel compiles the tier-1 envelope cannot afford cold.
 """
 
 import hashlib
@@ -33,8 +39,10 @@ import pytest
 from tendermint_tpu.crypto import batch as cbatch
 from tendermint_tpu.crypto import ed25519_ref as ref
 from tendermint_tpu.crypto.tpu import expanded as ex
+from tendermint_tpu.crypto.tpu import ledger as ld
 from tendermint_tpu.crypto.tpu import resident as rs
 from tendermint_tpu.crypto.tpu import verify as tv
+from tendermint_tpu.libs import failpoints
 from tendermint_tpu.libs.metrics import tpu_metrics
 
 
@@ -43,6 +51,7 @@ def _restore_fabric_knobs():
     yield
     ex.set_shard_crossover(None)
     rs.set_arena_shards(True)
+    failpoints.disarm("device.shard_fail")
     cbatch.reset_breakers()
 
 
@@ -163,6 +172,7 @@ def test_expanded_shard_args_pads_odd_bucket(monkeypatch):
     monkeypatch.setattr(tv, "_SHARD_MIN", 128)
     dummy = type("E", (), {})()
     dummy.sharded = False
+    dummy.mesh = mesh  # _shard_args lanes follow the placement mesh
     idx = np.zeros(256, np.int32)
     fields = {"sb": np.zeros((256, 64), np.uint8),
               "s_ok": np.zeros(256, bool),
@@ -373,9 +383,10 @@ def test_mesh_arena_launch_order_and_sentinels(monkeypatch):
 
 def test_speculation_attributes_failing_shard(monkeypatch, caplog):
     """Per-shard sentinel -> breaker attribution through the REAL
-    speculation plane: one lying chip opens the ed25519 breaker with
-    the shard/device named, every lane re-verifies on host, and the
-    commit still serves correct verdicts."""
+    speculation plane: one lying chip opens ITS OWN per-device breaker
+    (backend breaker stays closed — the other 7 devices keep serving)
+    with the shard/device named, every lane re-verifies on host, and
+    the commit still serves correct verdicts."""
     import logging
 
     from helpers import CHAIN_ID, make_genesis_state_and_pvs
@@ -412,8 +423,15 @@ def test_speculation_attributes_failing_shard(monkeypatch, caplog):
     with caplog.at_level(logging.ERROR):
         plane.flush_sync()
     assert isinstance(plane._arena, rs.MeshResidentArena)
-    assert plane._arena.failed_shards(), "a shard sentinel must fail"
-    assert cbatch.breaker("ed25519").state == cbatch.OPEN
+    failed = plane._arena.failed_shards()
+    assert failed, "a shard sentinel must fail"
+    # attribution is PER DEVICE: only the lying chip's breaker opens;
+    # the backend breaker stays closed so the fabric keeps serving on
+    # the 7 survivors (pre-self-healing this evicted the whole backend)
+    assert cbatch.breaker("ed25519").state == cbatch.CLOSED
+    states = cbatch.device_breaker_states("ed25519")
+    assert states.get(failed[0][1]) == cbatch.OPEN
+    assert sum(1 for s in states.values() if s != cbatch.CLOSED) == 1
     assert any("shard 1" in r.message for r in caplog.records), \
         "the failing shard/device must be named in the log"
     assert speculation_metrics().launches.value(
@@ -462,6 +480,149 @@ def test_sr25519_padded_dispatch_shape(monkeypatch):
     assert len(out) == n and bool(out.all())
     assert seen["bucket"] == 129
     assert seen["sharded"], "sr bucket fell off the mesh"
+
+
+# ----------------------- mesh self-healing (per-device breakers)
+
+
+def test_live_reshard_parity_evict_and_readmit(sharded_keys):
+    """The self-healing lifecycle on real kernels: full-mesh verdicts,
+    degraded (7-shard) verdicts after one device is evicted, and
+    re-admitted (8-shard) verdicts are byte-identical over the 30-key
+    straddle/partial fixture; the eviction is counted, the backend
+    breaker never opens, and the launch ledger stamps the degraded
+    launch with the 7 surviving devices."""
+    seeds, _pubs, shd = sharded_keys
+    mesh = _mesh8()
+    victim = str(mesh.devices.flat[5])
+    tamper = {5: "bad-sig", 11: "wrong-lane", 17: "malformed"}
+    idx, msgs, sigs, expect = _lanes(seeds, 48, tamper)
+    full = np.asarray(shd.verify(idx, msgs, sigs))
+    assert list(full) == expect and shd.n_shards == 8
+    ev_before = tpu_metrics().mesh_evictions.value(
+        device=victim, reason="launch_error")
+    cbatch.mark_device_failed("ed25519", device=victim)
+    try:
+        deg = np.asarray(shd.verify(idx, msgs, sigs))
+        assert shd.n_shards == 7, "fabric did not reshard"
+        assert victim not in [str(d) for d in shd.mesh.devices.flat]
+        assert (deg == full).all(), \
+            "degraded verdicts diverged from full-mesh"
+        assert cbatch.breaker("ed25519").state == cbatch.CLOSED
+        assert tpu_metrics().mesh_evictions.value(
+            device=victim, reason="launch_error") == ev_before + 1
+        stamped = [r for r in ld.snapshot() if r.get("active_devices")]
+        assert stamped and len(stamped[-1]["active_devices"]) == 7
+        assert victim not in stamped[-1]["active_devices"]
+    finally:
+        cbatch.readmit_device("ed25519", victim)
+    back = np.asarray(shd.verify(idx, msgs, sigs))
+    assert shd.n_shards == 8 and shd.keys_per_shard == 4
+    assert (back == full).all(), "re-admitted verdicts diverged"
+
+
+def test_continuity_eviction_between_launches(monkeypatch):
+    """10,001 lanes through the general kernel with a device evicted
+    BETWEEN launches: the next dispatch pads to the 7-device multiple
+    and rides the surviving mesh — no single-device collapse, no
+    backend-wide fallback (recording fake kernel: tier-1 cannot afford
+    the 16k-lane compile)."""
+    mesh = _mesh8()
+    seen = {}
+
+    def fake_kernel():
+        def k(*, btab, ab, sb, msg, nblocks, s_ok):
+            seen["bucket"] = ab.shape[0]
+            m = getattr(getattr(ab, "sharding", None), "mesh", None)
+            seen["devices"] = int(m.devices.size) if m is not None \
+                else 1
+            return np.ones(ab.shape[0], bool)
+        return k
+
+    monkeypatch.setattr(tv, "_kernel", fake_kernel)
+    n = 10_001
+    seed = hashlib.sha256(b"cont").digest()
+    pub = ref.public_key_from_seed(seed)
+    msg = b"m"
+    sig = ref.sign(seed, msg)
+    out = tv.verify_batch([pub] * n, [msg] * n, [sig] * n)
+    assert len(out) == n and bool(out.all())
+    assert seen["devices"] == 8 and seen["bucket"] == 16384
+    cbatch.mark_device_failed(
+        "ed25519", device=str(mesh.devices.flat[3]))
+    out = tv.verify_batch([pub] * n, [msg] * n, [sig] * n)
+    assert len(out) == n and bool(out.all())
+    # 16,384 % 7 != 0 -> padded to the next 7-multiple on survivors
+    assert seen["devices"] == 7 and seen["bucket"] == 16387
+    assert cbatch.breaker("ed25519").state == cbatch.CLOSED
+
+
+def test_device_shard_fail_failpoint_evicts_one_chip(monkeypatch):
+    """`device.shard_fail` armed corrupt;nth=3 mangles the 3rd mesh
+    device's payload at dispatch entry: exactly that chip is evicted
+    (reason=failpoint), the same dispatch already rides the 7
+    survivors, and the backend breaker never opens."""
+    mesh = _mesh8()
+    victim = str(mesh.devices.flat[2])
+    monkeypatch.setattr(tv, "_SHARD_MIN", 128)
+    seen = {}
+
+    def fake_kernel():
+        def k(*, btab, ab, sb, msg, nblocks, s_ok):
+            m = getattr(getattr(ab, "sharding", None), "mesh", None)
+            seen["devices"] = int(m.devices.size) if m is not None \
+                else 1
+            return np.ones(ab.shape[0], bool)
+        return k
+
+    monkeypatch.setattr(tv, "_kernel", fake_kernel)
+    seed = hashlib.sha256(b"fp").digest()
+    pub = ref.public_key_from_seed(seed)
+    msg = b"m"
+    sig = ref.sign(seed, msg)
+    fp_before = tpu_metrics().mesh_evictions.value(
+        device=victim, reason="failpoint")
+    failpoints.arm("device.shard_fail", "corrupt", nth=3)
+    try:
+        out = tv.verify_batch([pub] * 120, [msg] * 120, [sig] * 120)
+    finally:
+        failpoints.disarm("device.shard_fail")
+    assert len(out) == 120 and bool(out.all())
+    assert cbatch.evicted_devices("ed25519") == [victim]
+    assert cbatch.device_breaker_states("ed25519")[victim] == \
+        cbatch.OPEN
+    assert cbatch.breaker("ed25519").state == cbatch.CLOSED
+    assert seen["devices"] == 7, "dispatch did not exclude the chip"
+    assert tpu_metrics().mesh_evictions.value(
+        device=victim, reason="failpoint") == fp_before + 1
+
+
+def test_mesh_arena_reshards_after_eviction():
+    """MeshResidentArena.ensure_mesh() re-splices the global slot
+    round-robin over the surviving shards: installed keys land on
+    their new home devices and the arena reports the degraded width
+    (no launches — placement + routing only)."""
+    mesh = _mesh8()
+    arena = rs.MeshResidentArena(65, mesh=mesh)
+    _seeds, pubs = _keys(64, tag=b"rm")
+    arena.install_keys(pubs)
+    assert arena.n_shards == 8
+    cbatch.mark_device_failed(
+        "ed25519", device=str(mesh.devices.flat[6]))
+    assert arena.ensure_mesh() is True
+    assert arena.n_shards == 7
+    # key slots replayed onto the 7-wide round-robin: app lane 8
+    # (global slot 9) now lives on shard (9-1) % 7 + ... -> spot-check
+    # via the device-resident key bytes
+    found = 0
+    ab = np.array(arena._ab)  # (D, per, 32)
+    for d in range(arena.n_shards):
+        for s in range(arena.shard_capacity):
+            row = bytes(ab[d, s])
+            if row in set(pubs):
+                found += 1
+    assert found == 64, "installed keys lost in the reshard"
+    assert arena.ensure_mesh() is False  # stable: no second rebuild
 
 
 # ------------------------------------------------------- slow tier
@@ -595,3 +756,58 @@ def test_10240_lane_commit_acceptance():
         + arena.pre_len.nbytes + arena.suf_len.nbytes)
     assert max(per_dev) <= single_delta // 8 + template_overhead, \
         (per_dev, single_delta)
+
+
+@pytest.mark.slow
+def test_10240_lane_degraded_acceptance():
+    """The ISSUE self-healing acceptance at full size: with
+    `device.shard_fail` armed against one device of the 8-device host
+    mesh, a 10,240-lane verify over sharded tables completes with
+    correct verdicts on the 7 survivors — zero backend-wide host
+    fallback (backend breaker stays closed), the launch ledger stamps
+    the degraded launch with 7 active devices — and the evicted chip
+    re-admits through a REAL half-open known-answer probe, after
+    which verdicts are byte-identical at full width again."""
+    mesh = _mesh8()
+    n, n_keys = 10_240, 320
+    seeds, pubs = _keys(n_keys, tag=b"deg")
+    idx = [i % n_keys for i in range(n)]
+    msgs = [b"degraded lane %d" % i for i in range(n)]
+    sigs = [ref.sign(seeds[idx[i]], msgs[i]) for i in range(n)]
+    sigs[7_777] = sigs[7_777][:32] + bytes(32)
+    victim = str(mesh.devices.flat[4])
+
+    ex.set_shard_crossover(n_keys // 2)
+    try:
+        shd = ex.ExpandedKeys(pubs)
+        assert shd.sharded and shd.n_shards == 8
+        # the 5th per-device hit of the first dispatch = device index 4
+        failpoints.arm("device.shard_fail", "error", nth=5)
+        try:
+            got = np.asarray(shd.verify(idx, msgs, sigs))
+        finally:
+            failpoints.disarm("device.shard_fail")
+        assert cbatch.evicted_devices("ed25519") == [victim]
+        assert cbatch.breaker("ed25519").state == cbatch.CLOSED, \
+            "single-device failure must never open the backend breaker"
+        assert shd.n_shards == 7
+        assert not got[7_777] and int(got.sum()) == n - 1, \
+            "degraded verdicts wrong on the survivors"
+        stamped = [r for r in ld.snapshot() if r.get("active_devices")]
+        assert len(stamped[-1]["active_devices"]) == 7
+        assert victim not in stamped[-1]["active_devices"]
+        # re-admission through the REAL half-open path: expire the
+        # cooldown so the next dispatch's evicted_devices(probe=True)
+        # runs the 8-lane known-answer probe pinned to the chip — it
+        # passes, the breaker closes, and the same dispatch reshards
+        # back to full width
+        cbatch.device_breaker("ed25519", victim)._open_until = 0.0
+        got2 = np.asarray(shd.verify(idx, msgs, sigs))
+        assert cbatch.evicted_devices("ed25519") == []
+        assert cbatch.device_breaker_states("ed25519")[victim] == \
+            cbatch.CLOSED
+        assert shd.n_shards == 8
+        assert (got2 == got).all(), \
+            "re-admitted verdicts diverged from the degraded launch"
+    finally:
+        ex.set_shard_crossover(None)

@@ -282,6 +282,31 @@ def test_trust_collapse_disconnects_then_good_conduct_recovers():
     assert samples["recovered"][0] > samples["collapse"][0]
 
 
+def test_mesh_device_loss_scenario_two_seeds():
+    """ISSUE 18 acceptance: a verify-mesh chip fails mid-height and
+    the net keeps committing — the per-device breaker evicts exactly
+    that device (backend breaker stays closed), the watchdog reports
+    the eviction, the device re-admits, and every invariant stays
+    green — deterministically under two seeds."""
+    from tendermint_tpu.crypto import batch as cbatch
+
+    hashes = {}
+    for seed in (1, 2):
+        r = run_scenario(SCENARIOS["mesh_device_loss"](), seed)
+        cbatch.reset_breakers()
+        assert r["violations"] == [], (seed, r["violations"])
+        assert min(r["final_heights"]) >= 4
+        assert r["mesh_device"] in r["mesh_evicted"], seed
+        assert r["mesh_device"] not in r["mesh_readmitted"], seed
+        hashes[seed] = r["app_hashes"]
+        r2 = run_scenario(SCENARIOS["mesh_device_loss"](), seed)
+        cbatch.reset_breakers()
+        assert r2["violations"] == []
+        assert r2["app_hashes"] == r["app_hashes"], \
+            f"seed {seed} not deterministic"
+    assert hashes[1] != hashes[2]
+
+
 def test_smoke_shard_is_deterministic():
     """ISSUE 12 satellite (tier-1 smoke shard): a small seeded scenario
     batch runs deterministically — the identical (scenario, seed)

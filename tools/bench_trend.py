@@ -94,6 +94,18 @@ def classify_multichip(entry: dict) -> dict:
            "device": src.get("device"),
            "n_devices": src.get("n_devices", entry.get("n_devices")),
            "problems": []}
+    # crypto_bench --evict stamps a `degraded` block and the launch
+    # ledger stamps each record's active device set: a round that ran
+    # on fewer devices than the fabric holds measured different
+    # hardware, so it must not feed the full-mesh regression chain.
+    deg = src.get("degraded")
+    active = (deg.get("active_devices") if isinstance(deg, dict)
+              else src.get("active_devices"))
+    if isinstance(active, list):
+        row["active_devices"] = len(active)
+    row["degraded"] = bool(isinstance(deg, dict) or (
+        isinstance(active, list) and row["n_devices"]
+        and len(active) < int(row["n_devices"])))
     if entry.get("skipped"):
         return row
     if src.get("backend") or src.get("device"):
@@ -136,7 +148,8 @@ def find_regressions(rows: list[dict]) -> list[str]:
     last_by_backend: dict[str, dict] = {}
     for row in rows:
         b = row["backend"]
-        if b == "no-data" or row["value"] is None:
+        if b == "no-data" or row["value"] is None \
+                or row.get("degraded"):
             continue
         prev = last_by_backend.get(b)
         if prev is not None and prev["value"]:
@@ -168,6 +181,10 @@ def render_table(rows: list[dict]) -> str:
                    else f"(rc={r['rc']})")
             nd = (f" n_devices={r['n_devices']}"
                   if r.get("n_devices") else "")
+            if r.get("degraded"):
+                ad = r.get("active_devices")
+                nd += (f" degraded({ad}/{r['n_devices']})"
+                       if ad and r.get("n_devices") else " degraded")
             flag = "  !! " + "; ".join(r["problems"]) if r["problems"] \
                 else ""
             lines.append(f"  {r.get('file', r['round']):<18} {val:<18} "

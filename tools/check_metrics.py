@@ -50,6 +50,13 @@ REQUIRED = {
     "tpu_launch_ledger_records_total",
     "tpu_launch_ledger_evictions_total",
     "tpu_hbm_resident_bytes",
+    # mesh self-healing (per-device breakers + live reshard): the
+    # /status device check, the mesh degradation runbook and
+    # bench_trend's degraded-round separation read these
+    "tpu_device_breaker_state",
+    "tpu_mesh_evictions_total",
+    "tpu_reshard_seconds",
+    "tpu_mesh_active_devices",
 }
 
 

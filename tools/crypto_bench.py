@@ -12,7 +12,11 @@ mesh — forced-host CPU devices unless GRAFT_REAL_DEVICES=1):
 replicated vs key-range-sharded expanded tables, fresh-transfer vs
 resident-shard relaunches, with per-launch per-device byte accounting,
 emitted as one MULTICHIP-style JSON line (backend + n_devices stamped
-so a CPU run can never pass as silicon).
+so a CPU run can never pass as silicon). Add `--evict K` for the
+degraded-fabric A/B: K devices are breaker-evicted, the live reshard
+and the surviving-mesh verify are timed (verdicts asserted identical),
+the evicted devices re-admit, and the active device set the launch
+ledger recorded is stamped into the JSON line.
 """
 
 import hashlib
@@ -121,7 +125,7 @@ def _commit_lanes(n, n_keys):
     return pubs, idx, msgs, sigs
 
 
-def _mesh_ab(batch: int) -> int:
+def _mesh_ab(batch: int, evict: int = 0) -> int:
     """The multi-chip fabric A/B: replicated vs key-range-sharded
     expanded tables and fresh-transfer vs per-device resident-shard
     relaunches, with per-launch per-device byte accounting. Prints a
@@ -187,8 +191,54 @@ def _mesh_ab(batch: int) -> int:
         line["sharded_lanes_per_device"] = [
             int(c) for c in np.bincount(idx // shd.keys_per_shard,
                                         minlength=d_n)]
+
+        # -- D (--evict K): degraded-mesh A/B — evict K devices, time
+        # the live reshard + the degraded fabric, re-admit, and stamp
+        # the active device set the ledger recorded --
+        if evict:
+            from tendermint_tpu.crypto import batch as cbatch
+            from tendermint_tpu.crypto.tpu import ledger as tpu_ledger
+
+            assert 0 < evict < d_n - 1, \
+                "--evict K needs at least 2 surviving devices"
+            victims = [str(d) for d in mesh.devices.flat][-evict:]
+            cbatch.mark_device_failed("ed25519", device=victims,
+                                      reason="bench")
+            t0 = time.perf_counter()
+            deg = shd.verify(idx_l, msgs, sigs)  # reshards inline
+            reshard_launch_s = time.perf_counter() - t0
+            assert shd.n_shards == d_n - evict
+            assert (np.asarray(deg) == np.asarray(want)).all(), \
+                "degraded-mesh verdicts diverged"
+            t_deg = timeit(lambda: shd.verify(idx_l, msgs, sigs), 3)
+            active = next(
+                (r["active_devices"]
+                 for r in reversed(tpu_ledger.snapshot())
+                 if r.get("active_devices")), None)
+            for v in victims:
+                cbatch.readmit_device("ed25519", v)
+            t0 = time.perf_counter()
+            back = shd.verify(idx_l, msgs, sigs)  # reshards back
+            readmit_launch_s = time.perf_counter() - t0
+            assert shd.n_shards == d_n
+            assert (np.asarray(back) == np.asarray(want)).all(), \
+                "re-admitted-mesh verdicts diverged"
+            line["degraded"] = {
+                "evicted": victims,
+                "degraded_p50_ms": round(t_deg * 1e3, 3),
+                "full_p50_ms": line["sharded_p50_ms"],
+                "reshard_first_launch_ms": round(
+                    reshard_launch_s * 1e3, 3),
+                "readmit_first_launch_ms": round(
+                    readmit_launch_s * 1e3, 3),
+                "active_devices": active,
+            }
     finally:
         ex.set_shard_crossover(None)
+        if evict:
+            from tendermint_tpu.crypto import batch as cbatch
+
+            cbatch.reset_breakers()
 
     # -- C: fresh-transfer vs per-device resident-shard relaunch --
     delta = max(1, min(64, n // 16))
@@ -261,11 +311,14 @@ def main():
 
         force_cpu_backend()
     batch = 1024
+    evict = 0
     for i, a in enumerate(sys.argv):
         if a == "--batch":
             batch = int(sys.argv[i + 1])
+        elif a == "--evict":
+            evict = int(sys.argv[i + 1])
     if mesh_n:
-        sys.exit(_mesh_ab(batch))
+        sys.exit(_mesh_ab(batch, evict=evict))
 
     rows = []
 
