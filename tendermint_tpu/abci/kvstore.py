@@ -277,16 +277,35 @@ class PersistentKVStoreApp(KVStoreApp):
         if req.snapshot is None or req.snapshot.format != 1:
             return t.ResponseOfferSnapshot(t.OfferSnapshotResult.REJECT_FORMAT)
         self._restore_chunks: list[bytes] = []
+        self._restore_senders: list[str] = []
         self._restore_snapshot = req.snapshot
         return t.ResponseOfferSnapshot(t.OfferSnapshotResult.ACCEPT)
 
     def apply_snapshot_chunk(
         self, req: t.RequestApplySnapshotChunk
     ) -> t.ResponseApplySnapshotChunk:
+        from ..crypto import tmhash
+
         self._restore_chunks.append(req.chunk)
+        self._restore_senders.append(req.sender)
         if len(self._restore_chunks) < self._restore_snapshot.chunks:
             return t.ResponseApplySnapshotChunk(t.ApplySnapshotChunkResult.ACCEPT)
-        d = json.loads(b"".join(self._restore_chunks))
+        payload = b"".join(self._restore_chunks)
+        if tmhash.sum256(payload) != self._restore_snapshot.hash:
+            # The assembled payload is not what the advertised hash
+            # promised: at least one chunk is poisoned. Never parse it.
+            # When every chunk came from ONE sender the app can convict
+            # it by name (reject_senders); otherwise attribution is the
+            # syncer's job (single-source retries) and the app just
+            # asks for a snapshot retry with its partial state cleared.
+            senders = {s for s in self._restore_senders if s}
+            self._restore_chunks = []
+            self._restore_senders = []
+            return t.ResponseApplySnapshotChunk(
+                t.ApplySnapshotChunkResult.RETRY_SNAPSHOT,
+                reject_senders=sorted(senders) if len(senders) == 1
+                else [])
+        d = json.loads(payload)
         ops: list[tuple[bytes, bytes | None]] = [
             (bytes.fromhex(k), bytes.fromhex(v)) for k, v in d["kvs"].items()
         ]

@@ -67,7 +67,10 @@ def generate(rng: random.Random, seed: int | None = None) -> Manifest:
     # held back — never perturb it; tiny nets only get ops they can
     # survive without a quorum of helpers.
     perturbable = nodes - (1 if late_statesync else 0)
-    ops = PERTURB_OPS if nodes >= 3 else ("kill", "restart")
+    # statesync_poison is its own dimension below: it is only valid
+    # with a held-back statesync node to poison
+    ops = tuple(o for o in PERTURB_OPS if o != "statesync_poison") \
+        if nodes >= 3 else ("kill", "restart")
     # degrade-don't-kill failpoint rotation for sampled `chaos` ops
     # (docs/CHAOS.md): shapes every node must ride out under load
     chaos_choices = (
@@ -119,6 +122,17 @@ def generate(rng: random.Random, seed: int | None = None) -> Manifest:
                 duration=round(rng.uniform(1.0, 4.0), 1),
                 **kwargs,
             ))
+
+    # Adversarial statesync: with a held-back joiner in play, half the
+    # runs also turn one SERVING node into a chunk poisoner
+    # (statesync.serve corrupt armed for the whole restore) — the
+    # joiner must quarantine it and finish from the honest holders.
+    if late_statesync and rng.random() < 0.5:
+        m.perturbations.append(Perturbation(
+            node=rng.randrange(perturbable),
+            op="statesync_poison",
+            at_height=rng.randint(2, max(2, wait_height - 2)),
+        ))
 
     # Validator-power schedule: builtin app only (external abci-cli
     # kvstore has no validator txs). Power takes effect at H+2 and the

@@ -50,9 +50,17 @@ from dataclasses import dataclass, field
 # wrong-timestamp flood into the verify-ahead plane,
 # consensus/speculation.py) for `duration` seconds and assert
 # speculation hits drop to ZERO while the fallback path keeps every
-# commit verdict correct — the net must keep committing throughout
+# commit verdict correct — the net must keep committing throughout;
+# statesync_poison = arm `statesync.serve` corrupt on the node, so it
+# serves GARBLED snapshot chunks to the late_statesync_node's restore
+# (requires late_statesync_node; the target must not be the held-back
+# node itself). The poisoning stays armed through the whole restore;
+# after the net reaches wait_height the runner disarms it and — when
+# the poisoner actually served chunks — asserts the late joiner
+# quarantined a peer and retried the restore instead of wedging
 OPS = ("kill", "pause", "disconnect", "disconnect_hard", "restart",
-       "chaos", "overload", "light_proxy", "spec_mismatch")
+       "chaos", "overload", "light_proxy", "spec_mismatch",
+       "statesync_poison")
 
 
 @dataclass
@@ -267,6 +275,15 @@ class Manifest:
         if self.wait_height < 1:
             raise ValueError("wait_height must be >= 1")
         for p in self.perturbations:
+            if p.op == "statesync_poison":
+                if not self.late_statesync_node:
+                    raise ValueError(
+                        "statesync_poison requires late_statesync_node"
+                        " (it poisons the late joiner's restore)")
+                if p.node == self.nodes - 1:
+                    raise ValueError(
+                        "statesync_poison target must be a SERVING "
+                        "node, not the held-back statesync node")
             p.validate(self.nodes)
         for mb in self.misbehaviors:
             mb.validate(self.nodes)

@@ -318,6 +318,10 @@ class Runner:
         # one report dict per `spec_mismatch` perturbation — hit/miss
         # deltas under the wrong-timestamp flood + liveness through it
         self.spec_mismatch_reports: list[dict] = []
+        # `statesync_poison` perturbations stay armed through the late
+        # joiner's restore; checked + disarmed after wait_height
+        self._statesync_poisons: list = []
+        self.statesync_poison_reports: list[dict] = []
 
     # -- stages --
 
@@ -361,7 +365,8 @@ class Runner:
                    for p in self.m.perturbations):
                 cfg.rpc.unsafe = True  # exposes unsafe_net_sever
             pprof_port = 0
-            if any(p.op in ("chaos", "overload", "spec_mismatch")
+            if any(p.op in ("chaos", "overload", "spec_mismatch",
+                            "statesync_poison")
                    or (p.op == "kill" and p.failpoint)
                    for p in self.m.perturbations):
                 # chaos/overload perturbations drive the node's debug
@@ -772,6 +777,8 @@ class Runner:
             await self._apply_spec_mismatch(p, node)
         elif p.op == "light_proxy":
             await self._apply_light_proxy(p, node)
+        elif p.op == "statesync_poison":
+            await self._apply_statesync_poison(p, node)
         elif p.op == "chaos":
             # arm a named failpoint through the node's debug endpoint
             # for the window, then disarm — the net must degrade and
@@ -788,6 +795,59 @@ class Runner:
                                     "action": "off"})
         else:  # pragma: no cover - manifest validated
             raise ValueError(p.op)
+
+    async def _apply_statesync_poison(self, p: Perturbation,
+                                      node: NodeProc) -> None:
+        """Turn node p.node into a byzantine chunk server: arm
+        `statesync.serve` corrupt so every snapshot chunk it serves is
+        garbled in flight. The point STAYS armed through the late
+        statesync node's whole restore (manifest validation guarantees
+        late_statesync_node is on); check_statesync_poison() disarms
+        it after wait_height and asserts the joiner's quarantine."""
+        res = await self._debug_post(node, "/debug/failpoint",
+                                     {"name": "statesync.serve",
+                                      "action": "corrupt"})
+        assert "error" not in res, f"statesync_poison arm failed: {res}"
+        self._statesync_poisons.append(p)
+        self.log(f"perturb: node{p.node} now serves corrupted "
+                 "snapshot chunks (statesync.serve armed)")
+
+    async def check_statesync_poison(self) -> None:
+        """Post-run face of the poisoned-bootstrap invariant: the late
+        joiner reached wait_height (wait_all_height already gated
+        that — the poisoner never cost liveness). Here: disarm the
+        poisoners, and for every poisoner that actually SERVED chunks
+        assert the joiner quarantined a peer and needed more than one
+        restore attempt (chunk routing is height/peer-set dependent, so
+        a poisoner that never served is reported, not asserted)."""
+        import json
+
+        late = self.nodes[-1]
+        for p in self._statesync_poisons:
+            poisoner = self.nodes[p.node]
+            fires = 0
+            try:
+                st = json.loads(await self._debug_get(
+                    poisoner, "/debug/failpoint"))
+                fires = int(st["statesync.serve"]["fires"])
+            finally:
+                await self._debug_post(poisoner, "/debug/failpoint",
+                                       {"name": "statesync.serve",
+                                        "action": "off"})
+            status = json.loads(await self._debug_get(late, "/status"))
+            ss = status.get("checks", {}).get("statesync", {})
+            report = {"node": p.node, "chunks_poisoned": fires,
+                      "restore_attempts": ss.get("restore_attempt", 0),
+                      "quarantined": ss.get("quarantined_peers", [])}
+            self.statesync_poison_reports.append(report)
+            self.log(f"perturb: statesync_poison report {report}")
+            if fires > 0:
+                assert report["quarantined"], (
+                    f"node{p.node} served {fires} corrupted chunks but "
+                    "the late joiner quarantined nobody")
+                assert report["restore_attempts"] >= 2, (
+                    "poisoned restore completed without a retry — the "
+                    "corrupted chunks were applied unverified")
 
     async def _apply_kill_at_failpoint(self, p: Perturbation,
                                        node: NodeProc) -> None:
@@ -1307,6 +1367,8 @@ class Runner:
             if self.m.late_statesync_node:
                 await self.start_late_statesync_node()
             await self.wait_all_height(self.m.wait_height)
+            if self._statesync_poisons:
+                await self.check_statesync_poison()
             self.stop_load()
             await self.check_valset()
             report = await self.check()
@@ -1322,6 +1384,8 @@ class Runner:
                 report["light_proxy"] = self.light_proxy_reports
             if self.spec_mismatch_reports:
                 report["spec_mismatch"] = self.spec_mismatch_reports
+            if self.statesync_poison_reports:
+                report["statesync_poison"] = self.statesync_poison_reports
             try:
                 timeline = await self.collect_timeline()
             except Exception as e:  # forensics never fails the run

@@ -216,6 +216,21 @@ class HealthMonitor:
                 except Exception:  # pragma: no cover - monitor guard
                     logger.exception("speculation status check failed")
 
+        # -- statesync: restore progress + the poisoned-peer
+        # quarantine ledger, when this process ever ran a state sync
+        # (statesync/syncer.py). Consulted only if the module is
+        # already imported (a syncer can only exist then); quarantined
+        # peers mark the check degraded — the restore is healthy but
+        # an active poisoning attempt must be visible. --
+        mod = sys.modules.get("tendermint_tpu.statesync.syncer")
+        if mod is not None:
+            syncer = mod.active_syncer()
+            if syncer is not None:
+                try:
+                    checks["statesync"] = syncer.status_check()
+                except Exception:  # pragma: no cover - monitor guard
+                    logger.exception("statesync status check failed")
+
         # -- device: is the accelerator serving, and is the verify
         # queue draining? Per-backend circuit-breaker states (ed25519
         # and sr25519 degrade independently) MERGED with the silicon

@@ -155,6 +155,28 @@ CATALOG: tuple[FailpointDef, ...] = (
         "bytes; restore must fail the snapshot, not apply them)",
         payload=True),
     FailpointDef(
+        "statesync.offer",
+        "a discovered snapshot about to be offered to the app over "
+        "the snapshot ABCI connection (statesync/syncer.py _sync — "
+        "`crash` here must restart into clean discovery with no "
+        "partial restore state served)"),
+    FailpointDef(
+        "statesync.apply",
+        "a snapshot chunk about to be applied to the app (payload is "
+        "the chunk bytes; `corrupt` models a poisoned chunk reaching "
+        "the apply boundary — restore must retry with a new peer mix, "
+        "never serve the garbage; `crash` mid-restore must restart "
+        "into clean discovery)",
+        payload=True),
+    FailpointDef(
+        "statesync.serve",
+        "a snapshot chunk about to be served to a requesting peer "
+        "(statesync/reactor.py — payload is the chunk bytes; "
+        "`corrupt` turns THIS node into a chunk poisoner, the e2e "
+        "statesync_poison perturbation's attack shape: syncing peers "
+        "must quarantine it and restore from honest peers)",
+        payload=True),
+    FailpointDef(
         "mempool.admission.verify",
         "the admission plane's batched tx-signature verification "
         "launch (mempool/admission.py — device or host backend; "

@@ -579,6 +579,22 @@ class StateSyncMetrics:
         "chunk_retries_total",
         "Snapshot chunk fetches re-requested after a miss/timeout.",
         "statesync"))
+    chunks_refetched: Counter = field(
+        default_factory=lambda: DEFAULT.counter(
+            "chunks_refetched_total",
+            "Snapshot chunks discarded and re-fetched, by reason "
+            "(poisoned restore attempt, app refetch/retry verdicts).",
+            "statesync"))
+    peers_quarantined: Counter = field(
+        default_factory=lambda: DEFAULT.counter(
+            "peers_quarantined_total",
+            "Snapshot peers quarantined for serving provably bad "
+            "chunks or app-rejected senders.", "statesync"))
+    restore_attempts: Counter = field(
+        default_factory=lambda: DEFAULT.counter(
+            "restore_attempts_total",
+            "Snapshot restore attempts started (first try plus every "
+            "re-fetch with a rotated peer mix).", "statesync"))
 
 
 @dataclass

@@ -34,6 +34,8 @@ import copy
 from ..consensus import messages as m
 from ..consensus.misbehavior import DoublePrevote, DoublePropose
 from ..consensus.reactor import DATA_CHANNEL, VOTE_CHANNEL
+from ..statesync import messages as ssm
+from ..statesync.reactor import CHUNK_CHANNEL, SNAPSHOT_CHANNEL
 
 
 def wrap_peer_conduct(peer, conduct):
@@ -241,6 +243,79 @@ class TimestampSkew(Byzantine):
             skewed.timestamp = vote.timestamp + skew_ns
             skewed.signature = priv.sign(skewed.sign_bytes(chain_id))
             return [(chan_id, m.encode_consensus_msg(m.VoteMessage(skewed)))]
+
+        return f
+
+
+@register
+class SnapshotPoison(Byzantine):
+    """Serve CORRUPTED snapshot chunks: every outbound ChunkResponse
+    gets one bit flipped mid-payload (still decodable, wrong bytes).
+    The statesync surface this exercises is attribution — a joining
+    node's restore fails the trusted-app-hash check, the syncer
+    rotates to single-source attempts, and THIS node ends up
+    quarantined by name (pool ban + behaviour strike) while the
+    restore completes from the honest holders. Advertisements stay
+    honest: the poisoner wants to be picked."""
+
+    kind = "snapshot_poison"
+
+    def conduct_filter(self, node):
+        start, until = self.window()
+
+        def f(chan_id: int, msg: bytes):
+            if chan_id != CHUNK_CHANNEL:
+                return [(chan_id, msg)]
+            now = asyncio.get_running_loop().time()
+            if not start <= now < until:
+                return [(chan_id, msg)]
+            try:
+                decoded = ssm.decode_ss_msg(msg)
+            except Exception:
+                return [(chan_id, msg)]
+            if not isinstance(decoded, ssm.ChunkResponseMessage) or \
+                    not decoded.chunk:
+                return [(chan_id, msg)]
+            bad = bytearray(decoded.chunk)
+            bad[len(bad) // 2] ^= 0x40
+            return [(chan_id, ssm.encode_ss_msg(ssm.ChunkResponseMessage(
+                height=decoded.height, format=decoded.format,
+                index=decoded.index, chunk=bytes(bad),
+                missing=False)))]
+
+        return f
+
+
+@register
+class SnapshotLiar(Byzantine):
+    """Advertise snapshots at heights this node CANNOT serve: every
+    outbound SnapshotsResponse is lifted by `lift` heights (hash and
+    chunk count kept, so the advert looks plausible). A joining node
+    ranks the lie best (higher height wins), but the state provider
+    cannot light-verify the nonexistent height — the bogus snapshot is
+    rejected without a byte of chunk traffic and the restore proceeds
+    from the honest advertisements. The lie costs the liar a rejected
+    snapshot, never the joiner's liveness."""
+
+    kind = "snapshot_liar"
+
+    def conduct_filter(self, node):
+        lift = int(self.spec.get("lift", 1000))
+
+        def f(chan_id: int, msg: bytes):
+            if chan_id != SNAPSHOT_CHANNEL:
+                return [(chan_id, msg)]
+            try:
+                decoded = ssm.decode_ss_msg(msg)
+            except Exception:
+                return [(chan_id, msg)]
+            if not isinstance(decoded, ssm.SnapshotsResponseMessage):
+                return [(chan_id, msg)]
+            return [(chan_id, ssm.encode_ss_msg(
+                ssm.SnapshotsResponseMessage(
+                    height=decoded.height + lift, format=decoded.format,
+                    chunks=decoded.chunks, hash=decoded.hash,
+                    metadata=decoded.metadata)))]
 
         return f
 

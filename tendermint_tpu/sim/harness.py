@@ -192,12 +192,23 @@ class SimNode:
 
     def __init__(self, index: int, gdoc: GenesisDoc, pv, network: SimNetwork,
                  *, seed: int = 0, config: ConsensusConfig | None = None,
-                 gossip_sleep: float = 0.05):
+                 gossip_sleep: float = 0.05, snapshot_interval: int = 0,
+                 keep_snapshots: int = 4, state_provider_factory=None,
+                 run_consensus: bool = True):
         self.index = index
         self.gdoc = gdoc
         self.pv = pv
         self.network = network
         self.gossip_sleep = gossip_sleep
+        # statesync roles: snapshot_interval > 0 makes the node a
+        # snapshot SERVER; a state_provider_factory(node) makes it a
+        # statesync JOINER (run_consensus=False boots it without the
+        # consensus loop so a scenario probe can drive
+        # ss_reactor.sync() first, mirroring tests/p2p_harness.py)
+        self.snapshot_interval = snapshot_interval
+        self.keep_snapshots = keep_snapshots
+        self.state_provider_factory = state_provider_factory
+        self.run_consensus = run_consensus
         self.host = sim_host(index)
         self.port = SIM_PORT
         self.node_key = NodeKey(sim_priv_key(f"{seed}:node", index))
@@ -224,7 +235,9 @@ class SimNode:
 
     async def start(self) -> None:
         assert not self.running
-        self.app = PersistentKVStoreApp(self.app_db)
+        self.app = PersistentKVStoreApp(
+            self.app_db, snapshot_interval=self.snapshot_interval,
+            keep_snapshots=self.keep_snapshots)
         self.conns = AppConns(ClientCreator(app=self.app))
         await self.conns.start()
         self.state_store = Store(self.state_db)
@@ -244,13 +257,16 @@ class SimNode:
         if self.pv is not None:
             self.cs.set_priv_validator(self.pv)
         self.cs.misbehaviors.update(self.misbehavior_schedule)
-        self.reactor = ConsensusReactor(self.cs, wait_sync=False,
+        self.reactor = ConsensusReactor(self.cs,
+                                        wait_sync=not self.run_consensus,
                                         gossip_sleep=self.gossip_sleep)
         self.bc_reactor = BlockchainReactor(
             state, executor, self.block_store, fast_sync=False,
             consensus_reactor=self.reactor)
         self.ev_reactor = EvidenceReactor(self.evpool)
-        self.ss_reactor = StateSyncReactor(self.conns.snapshot, None)
+        provider = (self.state_provider_factory(self)
+                    if self.state_provider_factory is not None else None)
+        self.ss_reactor = StateSyncReactor(self.conns.snapshot, provider)
 
         def ni():
             return NodeInfo(node_id=self.node_key.id,
@@ -279,7 +295,8 @@ class SimNode:
         self.switch.add_reactor("statesync", self.ss_reactor)
         await self.transport.listen(self.host, self.port)
         await self.switch.start()
-        await self.cs.start()
+        if self.run_consensus:
+            await self.cs.start()
         self.running = True
         self.restarts += 1
 

@@ -94,6 +94,15 @@ class Scenario:
     byzantine: dict = field(default_factory=dict)
     tx_rate: float = 2.0            # txs per virtual second
     min_height: int = 3
+    # statesync serving: > 0 makes every node take app snapshots at
+    # this height interval (retained deep — the sim commits fast, and
+    # a snapshot pruned mid-fetch would flake the joiner)
+    snapshot_interval: int = 0
+    keep_snapshots: int = 10_000
+    # pad every injected tx value with this many filler bytes: fattens
+    # the app state so snapshots span MULTIPLE chunks (the statesync
+    # scenarios need round-robin fetches to touch every holder)
+    tx_pad: int = 0
     verify_backend: str = "host"    # "host" pins the deterministic oracle
     gossip_sleep: float = 0.05
     # ConsensusConfig field overrides on top of sim_consensus_config()
@@ -134,6 +143,9 @@ class Scenario:
             raise ValueError(f"unknown verify_backend {self.verify_backend!r}")
         if self.tier not in ("smoke", "slow"):
             raise ValueError(f"unknown tier {self.tier!r}")
+        if self.snapshot_interval < 0 or self.keep_snapshots < 1 or \
+                self.tx_pad < 0:
+            raise ValueError("bad snapshot settings")
         cfg = sim_consensus_config()
         for k in self.consensus:
             if not hasattr(cfg, k):
@@ -267,7 +279,9 @@ async def _run(sc: Scenario, seed: int, report: dict) -> None:
     for k, val in sc.consensus.items():
         setattr(config, k, val)
     nodes = [SimNode(i, gdoc, pvs[i], net, seed=seed, config=config,
-                     gossip_sleep=sc.gossip_sleep)
+                     gossip_sleep=sc.gossip_sleep,
+                     snapshot_interval=sc.snapshot_interval,
+                     keep_snapshots=sc.keep_snapshots)
              for i in range(sc.nodes)]
     # position k in the derivation: two same-kind specs on one node
     # must draw INDEPENDENT streams, not replay each other's
@@ -335,10 +349,11 @@ async def _tx_loader(sc: Scenario, nodes: list) -> None:
     the determinism check real material."""
     i = 0
     interval = 1.0 / sc.tx_rate
+    pad = b"." * sc.tx_pad
     while True:
         node = nodes[i % len(nodes)]
         if node.running:
-            node.mempool.add(b"sim-k%d=v%d" % (i, i))
+            node.mempool.add(b"sim-k%d=v%d" % (i, i) + pad)
         i += 1
         await asyncio.sleep(interval)
 
@@ -703,6 +718,123 @@ def _mesh_device_loss() -> Scenario:
     return sc
 
 
+def _statesync_poison_probe():
+    """Driver for statesync_poison: at t=10 boot a FRESH non-validator
+    SimNode and state-sync it off the live net — which contains one
+    `snapshot_poison` chunk corrupter and one `snapshot_liar`
+    advertising heights it cannot serve. The joiner must finish the
+    restore from the honest holders with the app bytes the light
+    client verified, quarantine the poisoner BY NAME, and shrug the
+    liar's adverts off as rejected snapshots. Every departure from
+    that is a first-class violation."""
+
+    async def probe(nodes, report):
+        from ..libs.db import MemDB
+        from ..light import (
+            BlockStoreProvider, Client, LightStore, TrustOptions,
+        )
+        from ..statesync.stateprovider import LightClientStateProvider
+        from .harness import SimNode
+
+        seed = report["seed"]
+        tag = f"[scenario=statesync_poison seed={seed}]"
+        honest, poisoner = nodes[0], nodes[3]
+        await asyncio.sleep(10.0)  # interval snapshots now exist
+
+        HOUR = 3600 * 10**9
+
+        def provider_factory(node):
+            # trusted state comes off an HONEST node's stores — the
+            # byzantine pair can only touch the snapshot channels
+            prov = BlockStoreProvider(honest.block_store,
+                                      honest.state_store, name="sim0")
+            lc = Client(
+                honest.gdoc.chain_id,
+                TrustOptions(period_ns=HOUR, height=1,
+                             hash=honest.block_store.load_block_meta(1)
+                             .block_id.hash),
+                prov, [prov], LightStore(MemDB()),
+                now_fn=lambda: honest.gdoc.genesis_time + HOUR // 2,
+            )
+            return LightClientStateProvider(lc)
+
+        joiner = SimNode(len(nodes), honest.gdoc, None, honest.network,
+                         seed=seed, config=honest.config,
+                         gossip_sleep=honest.gossip_sleep,
+                         state_provider_factory=provider_factory,
+                         run_consensus=False)
+        await joiner.start()
+        try:
+            for n in nodes:
+                await joiner.dial(n, persistent=False)
+            # let every holder's advertisements land before the sync
+            # picks a snapshot: the round-robin first attempt must
+            # know ALL the holders (poisoner included) or the restore
+            # would ride whoever answered first and never meet the
+            # adversary
+            await asyncio.sleep(2.0)
+            state, _commit = await asyncio.wait_for(
+                joiner.ss_reactor.sync(), 30.0)
+            syncer = joiner.ss_reactor.syncer
+            h = state.last_block_height
+            report["statesync"] = {
+                "height": h,
+                "restore_attempts": syncer._restore_attempt,
+                "quarantined": syncer.quarantined_peers(),
+            }
+            if joiner.app.height != h or \
+                    joiner.app.app_hash != state.app_hash:
+                report["violations"].append(
+                    f"statesync_poison: restored app h={joiner.app.height}"
+                    f" hash={joiner.app.app_hash.hex()} != verified state"
+                    f" h={h} hash={state.app_hash.hex()} {tag}")
+            want = honest.app_hash_after(h)
+            if want is not None and joiner.app.app_hash != want:
+                report["violations"].append(
+                    f"statesync_poison: restored app hash "
+                    f"{joiner.app.app_hash.hex()} != honest chain oracle "
+                    f"{want.hex()} at h={h} {tag}")
+            if poisoner.node_key.id not in syncer.quarantined_peers():
+                report["violations"].append(
+                    f"statesync_poison: poisoner {poisoner.node_key.id[:8]}"
+                    f" not quarantined (got {syncer.quarantined_peers()})"
+                    f" {tag}")
+            for n in (nodes[0], nodes[1]):
+                if n.node_key.id in syncer.quarantined_peers():
+                    report["violations"].append(
+                        f"statesync_poison: honest node {n.index} "
+                        f"({n.node_key.id[:8]}) wrongly quarantined {tag}")
+        except Exception as e:
+            report["violations"].append(
+                f"statesync_poison: joiner restore failed: {e!r} {tag}")
+        finally:
+            await joiner.stop()
+
+    return probe
+
+
+def _statesync_poison() -> Scenario:
+    """Adversarial bootstrap: all four validators serve interval
+    snapshots; node 3 poisons the chunks it serves, node 2 advertises
+    lifted heights it cannot serve. The probe's joining node must
+    still complete a verified restore from the honest holders with
+    the poisoner quarantined by name — a poisoner costs bandwidth,
+    never a joiner's liveness — while the validator net keeps
+    committing underneath."""
+    sc = Scenario(
+        name="statesync_poison", nodes=4, topology="full",
+        duration=22.0, snapshot_interval=2,
+        # ~20 padded txs land before the probe joins: the snapshot
+        # payload spans >= 3 chunks, so the round-robin first attempt
+        # touches every holder — including the poisoner
+        tx_pad=8192,
+        byzantine={3: {"kind": "snapshot_poison"},
+                   2: {"kind": "snapshot_liar", "lift": 1000}},
+        tx_rate=2.0, min_height=4)
+    sc.probe = _statesync_poison_probe()
+    return sc
+
+
 def _double_propose() -> Scenario:
     return Scenario(
         name="double_propose", nodes=4, topology="full", duration=20.0,
@@ -715,7 +847,7 @@ SCENARIOS: dict = {}
 for _f in (_smoke_quorum, _smoke_partition, _smoke_churn,
            _smoke_equivocation, _smoke_garbage_flood, _trust_collapse,
            _timestamp_skew, _withhold_parts, _double_propose,
-           _mesh_device_loss, _wan_50, _valset_10k):
+           _mesh_device_loss, _statesync_poison, _wan_50, _valset_10k):
     _sc = _f()
     _sc.validate()
     SCENARIOS[_sc.name] = _f

@@ -12,6 +12,7 @@ import asyncio
 import logging
 
 from ..abci import types as abci
+from ..libs import failpoints
 from ..p2p.conn.connection import ChannelDescriptor
 from ..p2p.switch import Reactor
 from .messages import (
@@ -42,7 +43,25 @@ class StateSyncReactor(Reactor):
         if state_provider is not None:
             self.syncer = Syncer(app_snapshot_conn, state_provider,
                                  self._request_chunk, discovery_time,
-                                 request_snapshots=self._request_snapshots)
+                                 request_snapshots=self._request_snapshots,
+                                 on_strike=self._strike_peer)
+
+    def _strike_peer(self, peer_id: str, reason: str) -> None:
+        """Route a syncer-detected fault (quarantined poisoner,
+        advertisement flood) into the behaviour trust score. Soft
+        strike: the quarantine already bans the peer from the pool;
+        the trust metric accumulates toward a switch-level stop."""
+        sw = self.switch
+        reporter = getattr(sw, "reporter", None) if sw is not None \
+            else None
+        if reporter is None:
+            return
+        try:
+            reporter.observe(peer_id, bad=1)
+            logger.warning("statesync strike on %s: %s",
+                           peer_id[:8], reason)
+        except Exception:  # conduct accounting must not fail the sync
+            logger.exception("statesync behaviour strike failed")
 
     def get_channels(self) -> list[ChannelDescriptor]:
         return [
@@ -134,10 +153,18 @@ class StateSyncReactor(Reactor):
                 from ..libs.metrics import statesync_metrics
 
                 statesync_metrics().chunks_served.inc()
+                # chaos: `corrupt` here turns THIS node into a chunk
+                # poisoner (the e2e statesync_poison attack shape) —
+                # syncing peers must quarantine it by name and finish
+                # the restore from honest holders
+                chunk = res.chunk
+                if chunk:
+                    chunk = failpoints.hit("statesync.serve",
+                                           payload=chunk)
                 await peer.send(CHUNK_CHANNEL, encode_ss_msg(
                     ChunkResponseMessage(
                         height=msg.height, format=msg.format,
-                        index=msg.index, chunk=res.chunk,
+                        index=msg.index, chunk=chunk,
                         missing=not res.chunk)))
             elif isinstance(msg, ChunkResponseMessage):
                 from ..libs.metrics import statesync_metrics

@@ -4,7 +4,22 @@ Pure-ish core: peer IO goes through two callables the reactor wires in
 (`request_snapshots(peer)` and `request_chunk(peer_id, snapshot, idx)`)
 so the whole flow is unit-testable without sockets. Chunks are held in
 memory (a redesign of the reference's temp-file chunkQueue — snapshot
-chunks are bounded at 16MB and restore is transient)."""
+chunks are bounded at 16MB and restore is transient).
+
+Byzantine peers are ATTRIBUTABLE: every chunk records the peer that
+supplied it (the provenance feeds `sender=` on ApplySnapshotChunk, so
+the app's `reject_senders` channel is live), and a restored-app-hash
+mismatch does not reject the snapshot the honest peers are also
+serving. Instead the restore RETRIES: the first attempt fetches
+round-robin for throughput; after a poisoned attempt, each retry
+fetches the full chunk set from ONE peer (rotating deterministically
+over the non-quarantined holders), so a failing attempt convicts its
+single source by name and a succeeding attempt convicts the original
+poisoners by byte-diffing their recorded chunks against the verified
+set. Convicted peers are quarantined (pool-banned + behaviour strike);
+the snapshot itself is rejected only once RESTORE_ATTEMPTS are
+exhausted or no untried peer mix remains — a poisoner costs bandwidth,
+never liveness."""
 
 from __future__ import annotations
 
@@ -31,6 +46,12 @@ CHUNK_FETCHERS = 4         # reference cfg.ChunkFetchers
 CHUNK_RETRIES = 8
 CHUNK_BACKOFF_BASE = 0.2
 CHUNK_BACKOFF_MAX = 5.0
+# Restore attempts per snapshot: the round-robin first try plus up to
+# three single-source retries. With one poisoner among >= 2 honest
+# holders the second attempt already has a 1/2 chance of an honest
+# source and the third is certain (the failing source is quarantined
+# between attempts).
+RESTORE_ATTEMPTS = 4
 
 
 def _chunk_backoff(attempt: int) -> float:
@@ -55,22 +76,45 @@ class _RejectFormat(StateSyncError):
     pass
 
 
+class _PoisonedRestore(StateSyncError):
+    """A restore attempt produced state the trusted app hash refutes
+    (or the app itself refused the assembled payload): retryable with
+    a different peer mix, never a verdict on the snapshot."""
+
+
+# Process-global registry for the /status statesync check
+# (libs/debugsrv.py consults it via sys.modules.get, so nodes that
+# never state-sync pay nothing).
+_ACTIVE_SYNCER: "Syncer | None" = None
+
+
+def active_syncer() -> "Syncer | None":
+    return _ACTIVE_SYNCER
+
+
 class Syncer:
     def __init__(self, app_snapshot_conn, state_provider,
                  request_chunk, discovery_time: float = DISCOVERY_TIME,
-                 request_snapshots=None):
+                 request_snapshots=None, on_strike=None):
         self.app = app_snapshot_conn
         self.state_provider = state_provider
         self.request_chunk = request_chunk  # async (peer_id, snapshot, idx)
         # sync callable: re-broadcast SnapshotsRequest (re-discovery
         # after a snapshot goes stale under us)
         self.request_snapshots = request_snapshots
+        # sync callable (peer_id, reason): route a provable fault to
+        # the behaviour reporter (trust strike); wired by the reactor
+        self.on_strike = on_strike
         self.discovery_time = discovery_time
-        self.pool = SnapshotPool()
+        self.pool = SnapshotPool(on_peer_overflow=self._on_pool_overflow)
         self._chunks: dict[int, bytes] = {}
+        self._chunk_senders: dict[int, str] = {}
         self._chunk_event = asyncio.Event()
         self._active: Snapshot | None = None
         self._requeue: set[int] = set()  # chunks whose peer said "missing"
+        self._quarantined: set[str] = set()
+        self._restore_attempt = 0
+        self._applied_count = 0
 
     # -- inbound from reactor --
 
@@ -85,6 +129,8 @@ class Syncer:
         if self._active is None or msg.height != self._active.height or \
                 msg.format != self._active.format:
             return
+        if peer_id and peer_id in self._quarantined:
+            return  # a quarantined peer's late chunks are dead on arrival
         if msg.missing:
             # THIS peer advertised the snapshot but no longer has it
             # (pruned while we were verifying/offering — common when
@@ -103,14 +149,67 @@ class Syncer:
         if not 0 <= msg.index < self._active.chunks:
             return
         # chaos: `corrupt` delivers garbled chunk bytes — restore must
-        # end in an app-hash mismatch that fails the snapshot, never in
-        # silently applied garbage
+        # end in a poisoned-attempt retry, never in silently applied
+        # garbage
         self._chunks[msg.index] = failpoints.hit("statesync.chunk",
                                                  payload=msg.chunk)
+        self._chunk_senders[msg.index] = peer_id
         self._chunk_event.set()
 
     def remove_peer(self, peer_id: str) -> None:
         self.pool.remove_peer(peer_id)
+
+    # -- quarantine --
+
+    def _on_pool_overflow(self, peer_id: str) -> None:
+        self._strike(peer_id, "snapshot advertisement flood")
+
+    def _strike(self, peer_id: str, reason: str) -> None:
+        if self.on_strike is None or not peer_id:
+            return
+        try:
+            self.on_strike(peer_id, reason)
+        except Exception:  # a broken reporter must not fail the sync
+            logger.exception("statesync behaviour strike failed")
+
+    def _quarantine(self, peer_id: str, reason: str) -> None:
+        """Ban a provably-lying snapshot peer: evict it from the pool
+        (its advertisements and chunks are dead from here) and strike
+        its trust score. Quarantine is BY NAME and permanent for this
+        syncer's life — visible in /status and the quarantine metric."""
+        if not peer_id or peer_id in self._quarantined:
+            return
+        self._quarantined.add(peer_id)
+        self.pool.reject_peer(peer_id)
+        from ..libs.metrics import statesync_metrics
+
+        statesync_metrics().peers_quarantined.inc()
+        logger.warning("statesync peer %s quarantined: %s",
+                       peer_id[:8], reason)
+        self._strike(peer_id, f"quarantined: {reason}")
+
+    def quarantined_peers(self) -> list[str]:
+        return sorted(self._quarantined)
+
+    def status_check(self) -> dict:
+        """The /status `statesync` check body (libs/debugsrv.py):
+        restore progress + the quarantine ledger. Quarantined peers
+        mark the check degraded — the restore is healthy, but an
+        active poisoning attempt is something an operator must see."""
+        snap = self._active
+        c: dict = {
+            "status": "ok",
+            "height": snap.height if snap is not None else 0,
+            "chunks_applied": self._applied_count,
+            "chunks_total": snap.chunks if snap is not None else 0,
+            "restore_attempt": self._restore_attempt,
+            "quarantined_peers": sorted(self._quarantined),
+        }
+        if self._quarantined:
+            c["status"] = "degraded"
+            c["detail"] = (f"{len(self._quarantined)} snapshot peer(s) "
+                           "quarantined for serving bad data")
+        return c
 
     # -- main flow --
 
@@ -118,6 +217,8 @@ class Syncer:
         """Try snapshots best-first until one restores and verifies.
         Returns (state, commit) for node bootstrap
         (reference: syncer.go:141 SyncAny)."""
+        global _ACTIVE_SYNCER
+        _ACTIVE_SYNCER = self
         deadline = asyncio.get_running_loop().time() + self.discovery_time
         while True:
             snapshot = self.pool.best()
@@ -159,34 +260,90 @@ class Syncer:
         # an unverifiable height fails before any restore work
         app_hash = await self.state_provider.app_hash(snapshot.height)
 
-        # 2) offer to the app
-        res = await self.app.offer_snapshot(abci.RequestOfferSnapshot(
-            snapshot=abci.Snapshot(
-                height=snapshot.height, format=snapshot.format,
-                chunks=snapshot.chunks, hash=snapshot.hash,
-                metadata=snapshot.metadata),
-            app_hash=app_hash))
-        self._dispatch_offer_result(res.result)
+        # 2/3) offer + restore, retrying with a rotated peer mix after
+        # a poisoned attempt (each re-offer resets the app's partial
+        # restore state, so no attempt leaks into the next)
+        # failed attempts' provenance: [{index: (bytes, sender)}]
+        failed: list[dict[int, tuple[bytes, str]]] = []
+        tried_sources: set[str] = set()
+        source: str | None = None  # None = round-robin first attempt
+        for attempt in range(1, RESTORE_ATTEMPTS + 1):
+            self._restore_attempt = attempt
+            from ..libs.metrics import statesync_metrics
 
-        # 3) fetch + apply chunks
-        self._active = snapshot
-        self._chunks = {}
-        self._requeue = set()
-        try:
-            await self._fetch_and_apply(snapshot)
-        finally:
-            self._active = None
+            statesync_metrics().restore_attempts.inc()
+            # chaos: a crash here (between discovery and the app
+            # accepting the offer) must restart into clean discovery
+            failpoints.hit("statesync.offer")
+            res = await self.app.offer_snapshot(abci.RequestOfferSnapshot(
+                snapshot=abci.Snapshot(
+                    height=snapshot.height, format=snapshot.format,
+                    chunks=snapshot.chunks, hash=snapshot.hash,
+                    metadata=snapshot.metadata),
+                app_hash=app_hash))
+            self._dispatch_offer_result(res.result)
 
-        # 4) confirm the restored app
-        info = await self.app.info(abci.RequestInfo())
-        if info.last_block_app_hash != app_hash:
-            raise StateSyncError(
-                f"restored app hash {info.last_block_app_hash.hex()} != "
-                f"trusted {app_hash.hex()}")
-        if info.last_block_height != snapshot.height:
-            raise StateSyncError(
-                f"restored app height {info.last_block_height} != "
-                f"snapshot height {snapshot.height}")
+            self._active = snapshot
+            self._chunks = {}
+            self._chunk_senders = {}
+            self._requeue = set()
+            self._applied_count = 0
+            try:
+                await self._fetch_and_apply(snapshot, source)
+                # 4) confirm the restored app
+                info = await self.app.info(abci.RequestInfo())
+                if info.last_block_height != snapshot.height:
+                    raise StateSyncError(
+                        f"restored app height {info.last_block_height} "
+                        f"!= snapshot height {snapshot.height}")
+                if info.last_block_app_hash != app_hash:
+                    raise _PoisonedRestore(
+                        f"restored app hash "
+                        f"{info.last_block_app_hash.hex()} != trusted "
+                        f"{app_hash.hex()}")
+            except _PoisonedRestore as e:
+                failed.append({
+                    i: (self._chunks[i], self._chunk_senders.get(i, ""))
+                    for i in self._chunks})
+                statesync_metrics().chunks_refetched.inc(
+                    len(self._chunks), reason="poisoned")
+                if source is not None:
+                    # single-source attempt: every chunk came from this
+                    # one peer and the trusted app hash refutes the
+                    # result — conviction by name
+                    self._quarantine(source,
+                                     "single-source restore attempt "
+                                     "refuted by trusted app hash")
+                logger.warning(
+                    "restore attempt %d/%d for snapshot h=%d poisoned "
+                    "(%s); rotating peer mix", attempt, RESTORE_ATTEMPTS,
+                    snapshot.height, e)
+                if attempt >= RESTORE_ATTEMPTS:
+                    raise _RejectSnapshot(
+                        f"{RESTORE_ATTEMPTS} restore attempts exhausted")
+                candidates = [p for p in self.pool.peers_of(snapshot)
+                              if p not in tried_sources]
+                if not candidates:
+                    raise _RejectSnapshot(
+                        "no untried peer mix left for snapshot")
+                source = candidates[0]
+                tried_sources.add(source)
+                continue
+            finally:
+                self._active = None
+            break
+
+        # a succeeding attempt convicts the original poisoners: any
+        # sender whose recorded chunk bytes differ from the verified
+        # set provably served garbage
+        if failed:
+            for rec in failed:
+                for idx, (bad_bytes, sender) in rec.items():
+                    if sender and self._chunks.get(idx) != bad_bytes:
+                        self._quarantine(
+                            sender,
+                            f"chunk {idx} diverges from the verified "
+                            "restore")
 
         state = await self.state_provider.state(snapshot.height)
         commit = await self.state_provider.commit(snapshot.height)
@@ -206,7 +363,12 @@ class Syncer:
             raise _RejectSnapshot()
         raise StateSyncError(f"unknown offer result {result}")
 
-    async def _fetch_and_apply(self, snapshot: Snapshot) -> None:
+    async def _fetch_and_apply(self, snapshot: Snapshot,
+                               source: str | None = None) -> None:
+        """Fetch + apply the chunk set. `source=None` round-robins over
+        every holder (throughput); a named `source` fetches EVERY chunk
+        from that one peer (the attribution mode after a poisoned
+        attempt — see _sync)."""
         applied = 0
         requested: dict[int, float] = {}
         attempts: dict[int, int] = {}    # fetch attempts per chunk
@@ -222,6 +384,8 @@ class Syncer:
                 not_before[idx] = loop.time() + _chunk_backoff(
                     attempts.get(idx, 0))
             peers = self.pool.peers_of(snapshot)
+            if source is not None:
+                peers = [p for p in peers if p == source]
             if not peers:
                 raise StateSyncError("no peers hold the snapshot")
             # (re-)request missing chunks, round-robin over peers
@@ -256,12 +420,19 @@ class Syncer:
             # apply whatever is ready, in order
             progressed = False
             while applied in self._chunks:
-                chunk = self._chunks[applied]
+                # chaos: `corrupt` garbles the chunk AT the apply
+                # boundary (poisoned-peer shape), `crash` dies
+                # mid-restore — the restart must re-enter discovery
+                # with no partial state served
+                chunk = failpoints.hit("statesync.apply",
+                                       payload=self._chunks[applied])
                 res = await self.app.apply_snapshot_chunk(
                     abci.RequestApplySnapshotChunk(
-                        index=applied, chunk=chunk, sender=""))
+                        index=applied, chunk=chunk,
+                        sender=self._chunk_senders.get(applied, "")))
                 applied = self._dispatch_apply_result(res, applied,
                                                       requested)
+                self._applied_count = applied
                 progressed = True
             if applied >= snapshot.chunks:
                 return
@@ -285,22 +456,39 @@ class Syncer:
                         if idx not in self._chunks:
                             requested[idx] = 0.0
 
+    def _drop_chunk(self, idx: int, requested: dict, reason: str) -> None:
+        self._chunks.pop(idx, None)
+        self._chunk_senders.pop(idx, None)
+        requested[idx] = 0.0
+        from ..libs.metrics import statesync_metrics
+
+        statesync_metrics().chunks_refetched.inc(reason=reason)
+
     def _dispatch_apply_result(self, res, applied: int,
                                requested: dict) -> int:
+        # the app's sender ban channel (reference syncer.go:352): a
+        # named sender is quarantined and every unapplied chunk it
+        # supplied is discarded for re-fetch from surviving peers
+        for sender in res.reject_senders:
+            self._quarantine(sender, "app rejected sender")
+            for idx in [i for i, s in self._chunk_senders.items()
+                        if s == sender and i > applied]:
+                self._drop_chunk(idx, requested, "rejected_sender")
         R = abci.ApplySnapshotChunkResult
         if res.result == R.ACCEPT:
             for idx in res.refetch_chunks:
-                self._chunks.pop(idx, None)
-                requested[idx] = 0.0
+                self._drop_chunk(idx, requested, "app_refetch")
             return applied + 1
         if res.result == R.RETRY:
-            self._chunks.pop(applied, None)
-            requested[applied] = 0.0
+            self._drop_chunk(applied, requested, "app_retry")
             return applied
         if res.result == R.ABORT:
             raise _AbortSync()
         if res.result == R.RETRY_SNAPSHOT:
-            raise StateSyncError("app requested snapshot retry")
+            # the app refused the assembled payload (e.g. its hash
+            # check failed): a poisoned attempt, retried with a new
+            # peer mix — NOT a verdict on the snapshot
+            raise _PoisonedRestore("app requested snapshot retry")
         if res.result == R.REJECT_SNAPSHOT:
             raise _RejectSnapshot()
         raise StateSyncError(f"unknown apply result {res.result}")

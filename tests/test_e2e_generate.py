@@ -42,7 +42,12 @@ def test_space_coverage():
     ops = {p.op for m in ms for p in m.perturbations}
     assert ops == {"kill", "pause", "disconnect", "disconnect_hard",
                    "restart", "chaos", "overload", "light_proxy",
-                   "spec_mismatch"}
+                   "spec_mismatch", "statesync_poison"}
+    # statesync_poison is only sampled alongside a held-back joiner,
+    # and never targets the joiner itself
+    assert all(m.late_statesync_node and p.node < m.nodes - 1
+               for m in ms for p in m.perturbations
+               if p.op == "statesync_poison")
     # sampled chaos ops carry a complete, valid failpoint spec
     assert all(p.failpoint and p.action in ("error", "delay", "corrupt")
                for m in ms for p in m.perturbations if p.op == "chaos")

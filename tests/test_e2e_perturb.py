@@ -43,6 +43,20 @@ duration = 1.5
             {"node": 0, "op": "nuke", "at_height": 1}]})
 
 
+def test_statesync_poison_manifest_validation():
+    # statesync_poison needs a late joiner to poison, and the target
+    # must be a serving node, not the held-back joiner itself
+    sp = {"node": 0, "op": "statesync_poison", "at_height": 2}
+    m = Manifest.from_dict({"nodes": 4, "late_statesync_node": True,
+                            "perturbations": [sp]})
+    assert m.perturbations[0].op == "statesync_poison"
+    with pytest.raises(ValueError, match="late_statesync"):
+        Manifest.from_dict({"nodes": 4, "perturbations": [sp]})
+    with pytest.raises(ValueError, match="SERVING"):
+        Manifest.from_dict({"nodes": 4, "late_statesync_node": True,
+                            "perturbations": [dict(sp, node=3)]})
+
+
 # Every subprocess-net block below is slow-tier: each boots a real
 # multi-node net (~60-100 s healthy; a 60 s progress-gate stall where
 # `cryptography` is missing), and together they were eating ~9 min of

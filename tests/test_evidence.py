@@ -138,6 +138,49 @@ def test_verify_rejects_non_validator():
                         ctx.block_store)
 
 
+def test_evidence_expiry_boundary_equal_age_is_not_expired():
+    """verify.py:28 expires evidence only when BOTH the height-age and
+    the time-age EXCEED their maxima (reference verify.go:33-47). Age
+    exactly equal to the limit — on both axes at once — must verify."""
+    ctx = _Ctx()
+    st = ctx.committed_state
+    p = st.consensus_params.evidence
+    st.last_block_height = 1 + p.max_age_num_blocks
+    st.last_block_time = ctx.block_time + p.max_age_duration_ns
+    verify_evidence(ctx.make_evidence(), st, ctx.state_store,
+                    ctx.block_store)
+
+
+def test_evidence_expiry_one_sided_age_is_not_expired():
+    """Exceeding only ONE of the two age limits is not expiry: old in
+    blocks but fresh in time (a chain that commits fast) and old in
+    time but fresh in blocks (a chain that stalls) both verify."""
+    # height-age over the limit, time-age exactly at it
+    ctx = _Ctx()
+    st = ctx.committed_state
+    p = st.consensus_params.evidence
+    st.last_block_height = 1 + p.max_age_num_blocks + 1
+    st.last_block_time = ctx.block_time + p.max_age_duration_ns
+    verify_evidence(ctx.make_evidence(), st, ctx.state_store,
+                    ctx.block_store)
+    # time-age over the limit, height-age exactly at it
+    st.last_block_height = 1 + p.max_age_num_blocks
+    st.last_block_time = ctx.block_time + p.max_age_duration_ns + 1
+    verify_evidence(ctx.make_evidence(), st, ctx.state_store,
+                    ctx.block_store)
+
+
+def test_evidence_expiry_both_exceeded_is_expired():
+    ctx = _Ctx()
+    st = ctx.committed_state
+    p = st.consensus_params.evidence
+    st.last_block_height = 1 + p.max_age_num_blocks + 1
+    st.last_block_time = ctx.block_time + p.max_age_duration_ns + 1
+    with pytest.raises(EvidenceError, match="too old"):
+        verify_evidence(ctx.make_evidence(), st, ctx.state_store,
+                        ctx.block_store)
+
+
 def test_pool_lifecycle():
     ctx = _Ctx()
     pool = Pool(MemDB(), ctx.state_store, ctx.block_store)

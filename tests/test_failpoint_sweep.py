@@ -453,6 +453,108 @@ def test_statesync_requeue_backoff_and_exhaustion(monkeypatch):
     asyncio.run(go())
 
 
+def _statesync_restore_doubles():
+    """(app, syncer) pair: one-peer, one-chunk restore whose app double
+    VERIFIES the applied bytes (info reports the trusted hash only for
+    the true chunk), so a corrupted apply is refuted like a poisoned
+    peer."""
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.statesync.snapshots import Snapshot
+    from tendermint_tpu.statesync.syncer import Syncer
+
+    data = b"\xaa" * 64
+
+    class App:
+        def __init__(self):
+            self.chunks: list[bytes] = []
+            self.offers = 0
+
+        async def offer_snapshot(self, req):
+            self.offers += 1
+            self.chunks = []  # re-offer resets partial restore state
+            return abci.ResponseOfferSnapshot(
+                abci.OfferSnapshotResult.ACCEPT)
+
+        async def apply_snapshot_chunk(self, req):
+            self.chunks.append(req.chunk)
+            return abci.ResponseApplySnapshotChunk(
+                abci.ApplySnapshotChunkResult.ACCEPT)
+
+        async def info(self, req):
+            ok = self.chunks == [data]
+            return abci.ResponseInfo(
+                last_block_height=1,
+                last_block_app_hash=b"H" * 8 if ok else b"X" * 8)
+
+    class Provider:
+        async def app_hash(self, height):
+            return b"H" * 8
+
+        async def state(self, height):
+            return f"state@{height}"
+
+        async def commit(self, height):
+            return f"commit@{height}"
+
+    app = App()
+    s = Syncer(app, Provider(), request_chunk=None, discovery_time=0.2)
+
+    async def feeder(peer_id, snapshot, idx):
+        s.add_chunk(_chunk_msg(idx, data), peer_id=peer_id)
+
+    s.request_chunk = feeder
+    s.add_snapshot("peerA", Snapshot(height=1, format=1, chunks=1,
+                                     hash=b"h"))
+    return app, s, data
+
+
+def test_sweep_statesync_offer_error_restart_reenters_discovery():
+    """statesync.offer `error` (the in-process shape of `crash`): the
+    sync dies between discovery and the app seeing the offer — zero
+    partial restore state exists, and a restarted syncer re-enters
+    discovery cleanly and completes."""
+    from tendermint_tpu.statesync.syncer import StateSyncError
+
+    async def go():
+        app, s, data = _statesync_restore_doubles()
+        fp.arm("statesync.offer", "error")
+        with pytest.raises(FailpointError):
+            await asyncio.wait_for(s.sync_any(), 10)
+        assert app.offers == 0 and app.chunks == []
+        # "restart": fresh syncer, same network — heals end to end
+        fp.reset()
+        app2, s2, data = _statesync_restore_doubles()
+        state, commit = await asyncio.wait_for(s2.sync_any(), 10)
+        assert state == "state@1" and app2.chunks == [data]
+
+    asyncio.run(go())
+
+
+def test_sweep_statesync_apply_corrupt_retries_never_serves_garbage():
+    """statesync.apply `corrupt` (nth=1): the first chunk is garbled
+    AT the apply boundary. The trusted app hash refutes the attempt,
+    the syncer retries with a rotated mix, and the restore completes
+    with the TRUE bytes — garbage is never left applied."""
+    async def go():
+        app, s, data = _statesync_restore_doubles()
+        fp.arm("statesync.apply", "corrupt", nth=1)
+        try:
+            state, _ = await asyncio.wait_for(s.sync_any(), 10)
+        finally:
+            fp.reset()
+        assert state == "state@1"
+        # the healed attempt applied the true chunk; the poisoned
+        # attempt's garbage was reset by the re-offer
+        assert app.chunks == [data]
+        assert s._restore_attempt == 2
+        assert s.pool._rejected_snapshots == set()
+        # the wire bytes were true — the peer is NOT falsely convicted
+        # (corruption happened at the apply boundary, not in transit)
+        assert s.quarantined_peers() == []
+
+    asyncio.run(go())
+
+
 def test_check_failpoints_lint_from_sweep():
     """Every registered point documented + tested + wired (the
     tools/check_failpoints.py contract) — run from the suite like

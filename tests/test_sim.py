@@ -160,6 +160,7 @@ def test_byzantine_catalog_registered():
     assert set(BYZANTINE_KINDS) == {
         "equivocation", "double_propose", "withhold_parts",
         "garbage_flood", "bad_signature_flood", "timestamp_skew",
+        "snapshot_poison", "snapshot_liar",
     }
 
 
@@ -305,6 +306,35 @@ def test_mesh_device_loss_scenario_two_seeds():
         assert r2["app_hashes"] == r["app_hashes"], \
             f"seed {seed} not deterministic"
     assert hashes[1] != hashes[2]
+
+
+def test_statesync_poison_scenario_two_seeds():
+    """ISSUE 20 acceptance: a fresh node state-syncs off a live net
+    containing a `snapshot_poison` chunk corrupter and a
+    `snapshot_liar` advertising heights it cannot serve. The joiner
+    completes a verified restore from the honest holders, the
+    poisoner is quarantined BY NAME, no honest peer is quarantined,
+    and the validator net keeps committing underneath — identically
+    across a re-run, under two seeds."""
+    from tendermint_tpu.sim.scenario import SCENARIOS as SC
+
+    reports = {}
+    for seed in (1, 2):
+        r = run_scenario(SC["statesync_poison"](), seed)
+        assert r["violations"] == [], (seed, r["violations"])
+        ss = r["statesync"]
+        assert ss["height"] >= 2 and ss["height"] % 2 == 0, ss
+        # the poisoned round-robin attempt forced at least one retry
+        assert ss["restore_attempts"] >= 2, ss
+        assert len(ss["quarantined"]) == 1, ss
+        assert min(r["final_heights"]) >= 4
+        r2 = run_scenario(SC["statesync_poison"](), seed)
+        assert r2["violations"] == []
+        assert r2["statesync"] == ss, f"seed {seed} not deterministic"
+        assert r2["app_hashes"] == r["app_hashes"], \
+            f"seed {seed} not deterministic"
+        reports[seed] = r
+    assert reports[1]["app_hashes"] != reports[2]["app_hashes"]
 
 
 def test_smoke_shard_is_deterministic():

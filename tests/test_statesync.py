@@ -225,6 +225,284 @@ def test_syncer_format_rejection_tries_other_snapshot():
     run(go())
 
 
+# --- bounded pool (ISSUE 20 satellite) ---------------------------------------
+
+def test_pool_per_peer_cap_refuses_flood_and_strikes():
+    """A peer advertising past its cap is refused (add() False) and
+    surfaced via on_peer_overflow so the reactor can strike its trust
+    score; other peers are unaffected."""
+    struck = []
+    pool = SnapshotPool(per_peer_cap=3, on_peer_overflow=struck.append)
+    for h in range(1, 4):
+        assert pool.add("flooder", _snap(h))
+    assert struck == []
+    assert pool.add("flooder", _snap(4)) is False
+    assert struck == ["flooder"]
+    assert len(pool) == 3
+    # an honest peer still advertises freely
+    assert pool.add("honest", _snap(4))
+    # re-associating with an ALREADY-HELD snapshot is not an advert
+    assert pool.add("flooder", _snap(3)) is False
+    assert struck == ["flooder"]
+
+
+def test_pool_global_cap_evicts_lowest_rank_deterministically():
+    pool = SnapshotPool(global_cap=3)
+    for h in (5, 6, 7):
+        assert pool.add("p1", _snap(h))
+    # newcomer outranks the worst (h=5): h=5 is evicted
+    assert pool.add("p1", _snap(8))
+    assert len(pool) == 3
+    assert sorted(s.height for s in pool._snapshots.values()) == [6, 7, 8]
+    # a newcomer that would itself rank last is refused outright
+    assert pool.add("p1", _snap(2)) is False
+    assert sorted(s.height for s in pool._snapshots.values()) == [6, 7, 8]
+
+
+# --- adversarial restore (ISSUE 20 tentpole) ---------------------------------
+
+class _AsyncConn:
+    """Async snapshot-conn adapter over a real (sync) kvstore app."""
+
+    def __init__(self, app):
+        self._app = app
+
+    async def offer_snapshot(self, req):
+        return self._app.offer_snapshot(req)
+
+    async def apply_snapshot_chunk(self, req):
+        return self._app.apply_snapshot_chunk(req)
+
+    async def info(self, req):
+        return self._app.info(req)
+
+    async def list_snapshots(self, req=None):
+        return self._app.list_snapshots(req)
+
+    async def load_snapshot_chunk(self, req):
+        return self._app.load_snapshot_chunk(req)
+
+
+def _server_app_with_snapshot(min_chunks=3):
+    """A real PersistentKVStoreApp grown until its interval snapshot
+    spans >= min_chunks chunks; returns (app, Snapshot)."""
+    from tendermint_tpu.abci.kvstore import PersistentKVStoreApp
+
+    server = PersistentKVStoreApp(snapshot_interval=6)
+    for h in range(1, 7):
+        for i in range(4):
+            server.deliver_tx(abci.RequestDeliverTx(
+                b"k%d-%d=" % (h, i) + b"v" * 4000))
+        server.commit(abci.RequestCommit())
+    s = server.list_snapshots(abci.RequestListSnapshots()).snapshots[-1]
+    assert s.chunks >= min_chunks, s.chunks
+    return server, Snapshot(s.height, s.format, s.chunks, s.hash)
+
+
+def test_poisoned_bootstrap_completes_and_quarantines_by_name():
+    """ISSUE 20 acceptance (tier-1, in-process): one byzantine chunk
+    server among >= 2 honest holders of the SAME snapshot. The restore
+    completes with a byte-identical app state vs the serving oracle,
+    the poisoner is quarantined BY NAME (pool ban + behaviour strike),
+    and the snapshot itself is never pool.reject()ed — the poisoner
+    costs bandwidth, never liveness."""
+    from tendermint_tpu.abci.kvstore import PersistentKVStoreApp
+    from tendermint_tpu.libs.metrics import statesync_metrics
+
+    async def go():
+        server, snap = _server_app_with_snapshot()
+        restoring = PersistentKVStoreApp()
+        strikes = []
+        q0 = statesync_metrics().peers_quarantined.value()
+        sy = Syncer(_AsyncConn(restoring),
+                    FakeStateProvider(app_hash=server.app_hash),
+                    request_chunk=None,
+                    on_strike=lambda p, r: strikes.append((p, r)))
+
+        async def feeder(peer_id, snapshot, idx):
+            chunk = server.load_snapshot_chunk(
+                abci.RequestLoadSnapshotChunk(
+                    height=snapshot.height, format=snapshot.format,
+                    chunk=idx)).chunk
+            if peer_id == "peer-poison":
+                chunk = chunk[:7] + b"\xff" + chunk[8:]
+            sy.add_chunk(ChunkResponseMessage(
+                snapshot.height, snapshot.format, idx, chunk), peer_id)
+
+        sy.request_chunk = feeder
+        for p in ("honest-a", "honest-b", "peer-poison"):
+            sy.add_snapshot(p, snap)
+        state, commit = await asyncio.wait_for(sy.sync_any(), 10)
+        assert state == f"state@{snap.height}"
+        # byte-identical restored state vs the serving oracle
+        assert restoring.app_hash == server.app_hash
+        assert restoring.height == server.height
+        assert (restoring._snapshot_payload()
+                == server._snapshot_payload())
+        # the poisoner — and ONLY the poisoner — is quarantined by name
+        assert sy.quarantined_peers() == ["peer-poison"]
+        assert sy.pool.is_rejected_peer("peer-poison")
+        assert not sy.pool.is_rejected_peer("honest-a")
+        assert any(p == "peer-poison" for p, _ in strikes)
+        # the snapshot the honest peers still serve was never rejected
+        assert sy.pool._rejected_snapshots == set()
+        assert statesync_metrics().peers_quarantined.value() == q0 + 1
+        # round-robin first attempt was poisoned; a rotated mix healed
+        assert sy._restore_attempt >= 2
+
+    run(go())
+
+
+def test_single_source_poisoned_attempt_convicts_the_source():
+    """When a single-source retry attempt is refuted by the trusted
+    app hash, that source is convicted by name and the NEXT rotation
+    completes the restore."""
+    from tendermint_tpu.abci.kvstore import PersistentKVStoreApp
+
+    async def go():
+        server, snap = _server_app_with_snapshot()
+        restoring = PersistentKVStoreApp()
+        sy = Syncer(_AsyncConn(restoring),
+                    FakeStateProvider(app_hash=server.app_hash),
+                    request_chunk=None)
+
+        async def feeder(peer_id, snapshot, idx):
+            chunk = server.load_snapshot_chunk(
+                abci.RequestLoadSnapshotChunk(
+                    height=snapshot.height, format=snapshot.format,
+                    chunk=idx)).chunk
+            # "aa-poison" sorts FIRST: it serves chunk 0 of the
+            # round-robin attempt AND is the first single-source pick
+            if peer_id == "aa-poison":
+                chunk = chunk[:7] + b"\xff" + chunk[8:]
+            sy.add_chunk(ChunkResponseMessage(
+                snapshot.height, snapshot.format, idx, chunk), peer_id)
+
+        sy.request_chunk = feeder
+        for p in ("aa-poison", "honest-a", "honest-b"):
+            sy.add_snapshot(p, snap)
+        await asyncio.wait_for(sy.sync_any(), 10)
+        assert restoring.app_hash == server.app_hash
+        assert sy.quarantined_peers() == ["aa-poison"]
+        # attempt 1 round-robin poisoned, attempt 2 single-source on
+        # the poisoner refuted, attempt 3 honest single-source healed
+        assert sy._restore_attempt == 3
+
+    run(go())
+
+
+def test_apply_verdict_reject_senders_and_refetch_chunks_honored():
+    """The app's ResponseApplySnapshotChunk channels are live: a named
+    reject_sender is quarantined and its buffered chunks re-fetched
+    from surviving peers; refetch_chunks are discarded and re-fetched
+    too."""
+    async def go():
+        chunks = [b"c0", b"c1", b"c2"]
+
+        class VerdictApp(ScriptedApp):
+            async def apply_snapshot_chunk(self, req):
+                self.applied.append(req.index)
+                if req.index == 0 and self.applied.count(0) == 1:
+                    return abci.ResponseApplySnapshotChunk(
+                        abci.ApplySnapshotChunkResult.ACCEPT,
+                        refetch_chunks=[1],
+                        reject_senders=["p-bad"])
+                return abci.ResponseApplySnapshotChunk(
+                    abci.ApplySnapshotChunkResult.ACCEPT)
+
+        app = VerdictApp(chunks)
+        sy = Syncer(app, FakeStateProvider(), request_chunk=None)
+        served = []
+
+        async def feeder(peer_id, snapshot, idx):
+            served.append((peer_id, idx))
+            sy.add_chunk(ChunkResponseMessage(
+                snapshot.height, snapshot.format, idx, chunks[idx]),
+                peer_id)
+
+        sy.request_chunk = feeder
+        snap = _snap(6, chunks=3)
+        for p in ("p-bad", "p-good"):
+            sy.add_snapshot(p, snap)
+        state, _ = await asyncio.wait_for(sy.sync_any(), 10)
+        assert state == "state@6"
+        # the app's named sender got quarantined mid-restore
+        assert sy.quarantined_peers() == ["p-bad"]
+        assert sy.pool.is_rejected_peer("p-bad")
+        # chunks 1 (refetch) and 2 (p-bad's, dropped) were re-served
+        refetched = [i for _, i in served[3:]]
+        assert set(refetched) >= {1, 2}, served
+        # and only the surviving peer served the refetches
+        assert all(p == "p-good" for p, _ in served[3:]), served
+        assert app.applied[-2:] == [1, 2]
+
+    run(go())
+
+
+def test_syncer_status_check_reports_quarantine_ledger():
+    sy = Syncer(None, FakeStateProvider(), request_chunk=None)
+    c = sy.status_check()
+    assert c["status"] == "ok" and c["quarantined_peers"] == []
+    sy._active = _snap(9, chunks=4)
+    sy._applied_count = 2
+    sy._restore_attempt = 2
+    sy._quarantine("peer-evil", "test")
+    c = sy.status_check()
+    assert c["status"] == "degraded"
+    assert c["height"] == 9
+    assert c["chunks_applied"] == 2 and c["chunks_total"] == 4
+    assert c["restore_attempt"] == 2
+    assert c["quarantined_peers"] == ["peer-evil"]
+    # quarantined chunks are dead on arrival
+    sy.add_chunk(ChunkResponseMessage(9, 1, 3, b"late"), "peer-evil")
+    assert 3 not in sy._chunks
+
+
+def test_serve_failpoint_corrupts_outbound_chunk_only():
+    """statesync.serve `corrupt` poisons the chunks THIS node serves
+    (the e2e statesync_poison attack shape) without flipping the
+    missing flag on genuinely absent chunks."""
+    from tendermint_tpu.libs import failpoints as fp
+    from tendermint_tpu.statesync.reactor import (
+        CHUNK_CHANNEL, StateSyncReactor,
+    )
+
+    class _Peer:
+        id = "peer-x"
+
+        def __init__(self):
+            self.sent = []
+
+        async def send(self, chan, msg):
+            self.sent.append((chan, decode_ss_msg(msg)))
+            return True
+
+    async def go():
+        server, snap = _server_app_with_snapshot(min_chunks=1)
+        reactor = StateSyncReactor(_AsyncConn(server), None)
+        peer = _Peer()
+        true_chunk = server.load_snapshot_chunk(
+            abci.RequestLoadSnapshotChunk(
+                height=snap.height, format=1, chunk=0)).chunk
+        fp.reset()
+        fp.arm("statesync.serve", "corrupt")
+        try:
+            await reactor.receive(CHUNK_CHANNEL, peer, encode_ss_msg(
+                ChunkRequestMessage(height=snap.height, format=1,
+                                    index=0)))
+            await reactor.receive(CHUNK_CHANNEL, peer, encode_ss_msg(
+                ChunkRequestMessage(height=999_999, format=1, index=0)))
+        finally:
+            fp.reset()
+        chan, served = peer.sent[0]
+        assert chan == CHUNK_CHANNEL
+        assert served.chunk != true_chunk and not served.missing
+        _, absent = peer.sent[1]
+        assert absent.missing and absent.chunk == b""
+
+    run(go())
+
+
 # --- full pipeline over TCP ---------------------------------------------------
 
 def test_statesync_then_fastsync_then_consensus():
